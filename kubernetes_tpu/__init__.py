@@ -1,0 +1,23 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling control plane.
+
+A from-scratch framework with the capabilities of the Kubernetes control plane
+(reference: mjg59/kubernetes), redesigned TPU-first:
+
+- A declarative, watch-driven object store (``kubernetes_tpu.store``) with
+  ResourceVersion / LIST+WATCH semantics — the hub every component talks through.
+- A client layer (``kubernetes_tpu.client``) reproducing the
+  reflector → informer → workqueue triangle every controller uses.
+- A scheduler (``kubernetes_tpu.scheduler``) exposing the same extension-point
+  framework (PreFilter/Filter/PostFilter/Score/Reserve/Permit/Bind...) as the
+  reference's pkg/scheduler/framework, but whose execution backend recasts the
+  per-pod Filter/Score loop as a batched (pods × nodes) tensor program
+  (``kubernetes_tpu.ops``) solved on TPU via XLA, sharded over a device mesh
+  (``kubernetes_tpu.parallel``).
+- Controllers (``kubernetes_tpu.controllers``) for workload and node lifecycle.
+
+Reference citations in docstrings use upstream Kubernetes paths + symbols
+(see SURVEY.md PROVENANCE: the reference mount was empty; symbols are the
+stable public layout of kubernetes/kubernetes which mjg59/kubernetes forks).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,37 @@
+"""API machinery: object model, resource quantities, label selectors.
+
+Mirrors the *capabilities* of the reference's apimachinery + api staging repos
+(staging/src/k8s.io/apimachinery, staging/src/k8s.io/api) without the Go type
+system: API objects are plain dicts in Kubernetes wire shape (camelCase keys),
+so reference manifests/YAML load unchanged. Typed accessors live beside them.
+"""
+
+from kubernetes_tpu.api.resource import Quantity, parse_quantity, format_quantity
+from kubernetes_tpu.api.labels import (
+    Selector,
+    match_label_selector,
+    parse_selector,
+)
+from kubernetes_tpu.api.meta import (
+    name_of,
+    namespace_of,
+    namespaced_name,
+    uid_of,
+    labels_of,
+    new_object,
+)
+
+__all__ = [
+    "Quantity",
+    "parse_quantity",
+    "format_quantity",
+    "Selector",
+    "match_label_selector",
+    "parse_selector",
+    "name_of",
+    "namespace_of",
+    "namespaced_name",
+    "uid_of",
+    "labels_of",
+    "new_object",
+]

@@ -1,0 +1,122 @@
+"""Object metadata helpers over wire-shape dicts.
+
+Parity target: staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go
+(`ObjectMeta`: name/namespace/uid/resourceVersion/labels/annotations/
+ownerReferences/creationTimestamp/deletionTimestamp/finalizers).
+
+API objects in this framework ARE their wire form: plain nested dicts with
+camelCase keys, exactly what the reference serializes to JSON. That choice makes
+the store trivially serializable, lets reference YAML load unchanged, and avoids
+a conversion layer (the reference's internal-hub-type machinery exists to manage
+N wire versions; we have one).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Mapping
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def new_object(
+    kind: str,
+    name: str,
+    namespace: str | None = "default",
+    labels: Mapping[str, str] | None = None,
+    annotations: Mapping[str, str] | None = None,
+    api_version: str = "v1",
+    **spec_fields: Any,
+) -> dict:
+    """Build a minimal API object dict with populated metadata."""
+    meta: dict[str, Any] = {"name": name, "uid": new_uid()}
+    if namespace is not None:
+        meta["namespace"] = namespace
+    if labels:
+        meta["labels"] = dict(labels)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    obj: dict[str, Any] = {"apiVersion": api_version, "kind": kind, "metadata": meta}
+    obj.update(spec_fields)
+    return obj
+
+
+def name_of(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace_of(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("namespace", "")
+
+
+def uid_of(obj: Mapping) -> str:
+    return obj.get("metadata", {}).get("uid", "")
+
+
+def labels_of(obj: Mapping) -> dict:
+    return obj.get("metadata", {}).get("labels") or {}
+
+
+def annotations_of(obj: Mapping) -> dict:
+    return obj.get("metadata", {}).get("annotations") or {}
+
+
+def resource_version_of(obj: Mapping) -> int:
+    rv = obj.get("metadata", {}).get("resourceVersion", "0")
+    return int(rv) if rv else 0
+
+
+def namespaced_name(obj: Mapping) -> str:
+    """"ns/name" key, or bare name for cluster-scoped objects (e.g. Node)."""
+    ns = namespace_of(obj)
+    return f"{ns}/{name_of(obj)}" if ns else name_of(obj)
+
+
+def owner_references_of(obj: Mapping) -> list:
+    return obj.get("metadata", {}).get("ownerReferences") or []
+
+
+def controller_ref_of(obj: Mapping) -> dict | None:
+    """The single controller=true ownerReference, if any
+    (metav1.GetControllerOf)."""
+    for ref in owner_references_of(obj):
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def new_controller_ref(owner: Mapping, kind: str | None = None) -> dict:
+    """metav1.NewControllerRef equivalent."""
+    return {
+        "apiVersion": owner.get("apiVersion", "v1"),
+        "kind": kind or owner.get("kind", ""),
+        "name": name_of(owner),
+        "uid": uid_of(owner),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def set_creation_timestamp(obj: dict) -> None:
+    obj.setdefault("metadata", {}).setdefault("creationTimestamp", now_iso())
+
+
+def deep_copy(obj: Any) -> Any:
+    """Structure-aware deep copy for wire objects (dicts/lists/scalars only).
+
+    Much faster than copy.deepcopy for this shape; the store hands copies out so
+    callers can't mutate cached state (the reference relies on Go value
+    semantics + informer "never mutate cache objects" convention instead).
+    """
+    if isinstance(obj, dict):
+        return {k: deep_copy(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [deep_copy(v) for v in obj]
+    return obj

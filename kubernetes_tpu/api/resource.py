@@ -1,0 +1,122 @@
+"""Resource quantities.
+
+Capability parity with the reference's resource.Quantity
+(staging/src/k8s.io/apimachinery/pkg/api/resource/quantity.go: `Quantity`,
+`ParseQuantity`, `MilliValue`): parse/format the Kubernetes quantity grammar —
+decimal SI suffixes (k, M, G, T, P, E), binary suffixes (Ki, Mi, Gi, Ti, Pi, Ei),
+milli ("500m"), bare integers and decimals ("0.5", "2e3").
+
+TPU-first deviation: instead of the reference's infinite-precision decimal with
+cached scaled ints, we canonicalize every quantity to an **integer milli-value**
+(int64-safe for realistic cluster sizes). All scheduler math then happens on
+integer/float tensors; string round-tripping is only for the API surface. This is
+what lets a node's allocatable vector become one row of an (N × R) int array.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Union
+
+_BIN = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
+_DEC = {
+    "n": 10**-9, "u": 10**-6, "m": 10**-3, "": 1,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18,
+}
+
+_QTY_RE = re.compile(
+    r"^\s*([+-]?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)\s*"
+    r"(Ki|Mi|Gi|Ti|Pi|Ei|n|u|m|k|M|G|T|P|E)?\s*$"
+)
+
+
+def parse_quantity(s: Union[str, int, float, None]) -> int:
+    """Parse a quantity into integer milli-units.
+
+    "1" → 1000, "500m" → 500, "2Gi" → 2*2**30*1000, 1.5 → 1500.
+    None/"" → 0. Raises ValueError on malformed input (the reference's
+    ParseQuantity errors likewise).
+    """
+    if s is None or s == "":
+        return 0
+    if isinstance(s, bool):
+        raise ValueError(f"invalid quantity: {s!r}")
+    if isinstance(s, int):
+        return s * 1000
+    if isinstance(s, float):
+        return round(s * 1000)
+    m = _QTY_RE.match(s)
+    if not m:
+        raise ValueError(f"invalid quantity: {s!r}")
+    num, suffix = m.group(1), m.group(2) or ""
+    if suffix in _BIN:
+        mult = _BIN[suffix]
+    else:
+        mult = _DEC[suffix]
+    val = float(num) * mult * 1000
+    return round(val)
+
+
+def format_quantity(milli: int) -> str:
+    """Format integer milli-units back to a canonical quantity string.
+
+    Whole units print bare ("2"); sub-unit values print in milli ("500m").
+    Large byte-ish values are NOT re-suffixed (canonicalization to suffixes is
+    cosmetic; the reference also accepts any equivalent form).
+    """
+    if milli % 1000 == 0:
+        return str(milli // 1000)
+    return f"{milli}m"
+
+
+class Quantity:
+    """Thin value wrapper, mostly for tests/debugging; hot paths use raw ints."""
+
+    __slots__ = ("milli",)
+
+    def __init__(self, value: Union[str, int, float, "Quantity", None] = 0):
+        if isinstance(value, Quantity):
+            self.milli = value.milli
+        else:
+            self.milli = parse_quantity(value)
+
+    def value(self) -> float:
+        return self.milli / 1000
+
+    def milli_value(self) -> int:
+        return self.milli
+
+    def __add__(self, other: "Quantity") -> "Quantity":
+        q = Quantity()
+        q.milli = self.milli + Quantity(other).milli
+        return q
+
+    def __sub__(self, other: "Quantity") -> "Quantity":
+        q = Quantity()
+        q.milli = self.milli - Quantity(other).milli
+        return q
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, (Quantity, str, int, float)) and Quantity(other).milli == self.milli
+
+    def __lt__(self, other) -> bool:
+        return self.milli < Quantity(other).milli
+
+    def __le__(self, other) -> bool:
+        return self.milli <= Quantity(other).milli
+
+    def __hash__(self) -> int:
+        return hash(self.milli)
+
+    def __repr__(self) -> str:
+        return f"Quantity({format_quantity(self.milli)!r})"
+
+    def __str__(self) -> str:
+        return format_quantity(self.milli)
+
+
+def parse_resource_list(resources: dict | None) -> dict[str, int]:
+    """Parse a ResourceList ({"cpu": "500m", "memory": "1Gi"}) → {name: milli}."""
+    if not resources:
+        return {}
+    return {name: parse_quantity(v) for name, v in resources.items()}

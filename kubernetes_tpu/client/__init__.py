@@ -1,0 +1,32 @@
+"""Client runtime: the reflector → informer → workqueue triangle (client-go
+tools/cache + util/workqueue equivalents), event recording, leader election."""
+
+from kubernetes_tpu.client.informer import (
+    Indexer,
+    InformerFactory,
+    ResourceEventHandler,
+    SharedInformer,
+    namespace_index,
+)
+from kubernetes_tpu.client.workqueue import (
+    DelayingQueue,
+    ExponentialFailureRateLimiter,
+    RateLimitingQueue,
+    WorkQueue,
+)
+from kubernetes_tpu.client.events import EventRecorder
+from kubernetes_tpu.client.leaderelection import LeaderElector
+
+__all__ = [
+    "Indexer",
+    "InformerFactory",
+    "ResourceEventHandler",
+    "SharedInformer",
+    "namespace_index",
+    "DelayingQueue",
+    "ExponentialFailureRateLimiter",
+    "RateLimitingQueue",
+    "WorkQueue",
+    "EventRecorder",
+    "LeaderElector",
+]

@@ -1,0 +1,58 @@
+"""Event recording — user-facing explainability ("FailedScheduling" etc.).
+
+Parity target: staging/src/k8s.io/client-go/tools/record/event.go
+(`EventRecorder.Eventf` → Event API objects with involvedObject/reason/message,
+count-aggregated). The scheduler must keep emitting per-pod failure reasons even
+when plugins fuse into one XLA program (SURVEY §5.5) — the per-plugin unsat
+masks feed `reason`/`message` here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Mapping
+
+from kubernetes_tpu.api.meta import name_of, namespace_of, new_object, now_iso
+from kubernetes_tpu.store.mvcc import MVCCStore, StoreError
+
+logger = logging.getLogger(__name__)
+_seq = itertools.count(1)
+
+
+class EventRecorder:
+    def __init__(self, store: MVCCStore, component: str):
+        self.store = store
+        self.component = component
+
+    def event(self, obj: Mapping, event_type: str, reason: str, message: str) -> None:
+        """Fire-and-forget, like the reference's buffered broadcaster."""
+        ev = new_object(
+            "Event",
+            f"{name_of(obj)}.{next(_seq):x}",
+            namespace_of(obj) or "default",
+            involvedObject={
+                "kind": obj.get("kind", ""),
+                "name": name_of(obj),
+                "namespace": namespace_of(obj),
+                "uid": obj.get("metadata", {}).get("uid", ""),
+            },
+            type=event_type,  # Normal | Warning
+            reason=reason,
+            message=message,
+            source={"component": self.component},
+            firstTimestamp=now_iso(),
+            count=1,
+        )
+
+        async def write():
+            try:
+                await self.store.create("events", ev)
+            except StoreError:
+                logger.debug("event write failed", exc_info=True)
+
+        try:
+            asyncio.ensure_future(write())
+        except RuntimeError:
+            pass  # no running loop (unit tests exercising sync paths)

@@ -1,0 +1,109 @@
+"""Lease-based leader election for active/passive HA.
+
+Parity target: staging/src/k8s.io/client-go/tools/leaderelection
+(`LeaderElector.Run`: acquire → renew loop → on lost call OnStoppedLeading;
+resourcelock on coordination.k8s.io/Lease). Fencing is by lease holder identity
++ RV CAS, exactly as the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+from kubernetes_tpu.api.meta import new_object
+from kubernetes_tpu.store.mvcc import AlreadyExists, Conflict, MVCCStore, NotFound
+
+LEASES = "leases"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        store: MVCCStore,
+        lock_name: str,
+        identity: str,
+        lease_duration: float = 15.0,
+        renew_deadline: float = 10.0,
+        retry_period: float = 2.0,
+        namespace: str = "kube-system",
+    ):
+        self.store = store
+        self.lock_name = lock_name
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.namespace = namespace
+        self.is_leader = False
+
+    def _key(self) -> str:
+        return f"{self.namespace}/{self.lock_name}"
+
+    async def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        try:
+            lease = await self.store.get(LEASES, self._key())
+        except NotFound:
+            lease = new_object(
+                "Lease", self.lock_name, self.namespace,
+                spec={"holderIdentity": self.identity,
+                      "acquireTime": now, "renewTime": now,
+                      "leaseDurationSeconds": self.lease_duration},
+            )
+            try:
+                await self.store.create(LEASES, lease)
+                return True
+            except AlreadyExists:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        expired = now > spec.get("renewTime", 0) + spec.get(
+            "leaseDurationSeconds", self.lease_duration)
+        if holder != self.identity and not expired:
+            return False
+
+        def mutate(obj):
+            s = obj.setdefault("spec", {})
+            if s.get("holderIdentity") != self.identity:
+                if time.time() <= s.get("renewTime", 0) + s.get(
+                        "leaseDurationSeconds", self.lease_duration):
+                    return None  # someone else renewed first
+                s["acquireTime"] = time.time()
+            s["holderIdentity"] = self.identity
+            s["renewTime"] = time.time()
+            s["leaseDurationSeconds"] = self.lease_duration
+            return obj
+
+        try:
+            updated = await self.store.guaranteed_update(LEASES, self._key(), mutate)
+        except Conflict:
+            return False
+        return updated.get("spec", {}).get("holderIdentity") == self.identity
+
+    async def run(
+        self,
+        on_started_leading: Callable[[], Awaitable[None]],
+        on_stopped_leading: Callable[[], None] | None = None,
+    ) -> None:
+        """Block acquiring; then run the payload while renewing. If renewal
+        fails past the deadline, cancel the payload (fencing)."""
+        while not await self._try_acquire_or_renew():
+            await asyncio.sleep(self.retry_period)
+        self.is_leader = True
+        payload = asyncio.ensure_future(on_started_leading())
+        try:
+            last_renew = time.time()
+            while not payload.done():
+                await asyncio.sleep(self.retry_period)
+                if await self._try_acquire_or_renew():
+                    last_renew = time.time()
+                elif time.time() - last_renew > self.renew_deadline:
+                    payload.cancel()
+                    break
+            await asyncio.gather(payload, return_exceptions=True)
+        finally:
+            self.is_leader = False
+            if on_stopped_leading:
+                on_stopped_leading()

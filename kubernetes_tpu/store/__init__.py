@@ -1,0 +1,33 @@
+"""The object store: the hub of the control plane (SURVEY §1: everything is
+hub-and-spoke through the store; components communicate only by reading/writing
+objects and watching for changes)."""
+
+from kubernetes_tpu.store.mvcc import (
+    AlreadyExists,
+    Conflict,
+    Event,
+    Expired,
+    Invalid,
+    ListResult,
+    MVCCStore,
+    NotFound,
+    StoreError,
+    binding_subresource,
+    new_cluster_store,
+)
+from kubernetes_tpu.store.validation import install_core_validation
+
+__all__ = [
+    "AlreadyExists",
+    "Conflict",
+    "Event",
+    "Expired",
+    "Invalid",
+    "ListResult",
+    "MVCCStore",
+    "NotFound",
+    "StoreError",
+    "binding_subresource",
+    "new_cluster_store",
+    "install_core_validation",
+]

@@ -1,0 +1,472 @@
+"""In-memory MVCC object store with etcd-compatible semantics.
+
+Capability parity with the reference's storage stack
+(staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go: `Create`, `Get`,
+`GuaranteedUpdate` (CAS loop on ResourceVersion), `Delete`, `List`;
+etcd3/watcher.go + storage/cacher/cacher.go: watch streams, bookmarks,
+"410 Gone" on compacted revisions). etcd itself is out of scope — it is an
+external dependency of the reference too; what every component actually
+depends on is *these semantics*:
+
+- A single monotonically-increasing **ResourceVersion** across the whole store.
+- Every write bumps it; objects carry the RV of their last write.
+- LIST returns a consistent snapshot + the store RV to resume watching from.
+- WATCH(rv) replays every event after rv in order, then streams live events,
+  with periodic **bookmark** events carrying the current RV.
+- WATCH from an RV older than the retained window ⇒ **Expired** (410 Gone),
+  client must relist (client-go Reflector handles this).
+- **GuaranteedUpdate** = optimistic-concurrency read-modify-write retried on
+  conflict — the primitive Binding, status updates, and controllers build on.
+
+Concurrency model: single asyncio loop owns all state (the TPU-build analog of
+the reference's "one mutex around cacheImpl" discipline, see SURVEY §5.2); the
+public API is async and must be called from that loop. A thread-safe facade for
+the scheduler's compiled hot path lives in kubernetes_tpu/client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Awaitable, Callable, Mapping
+
+from kubernetes_tpu.api.labels import Selector
+from kubernetes_tpu.api.meta import (
+    deep_copy,
+    name_of,
+    namespace_of,
+    set_creation_timestamp,
+)
+
+
+class StoreError(Exception):
+    status = 500
+
+
+class NotFound(StoreError):
+    status = 404
+
+
+class AlreadyExists(StoreError):
+    status = 409
+
+
+class Conflict(StoreError):
+    """ResourceVersion precondition failed (optimistic concurrency)."""
+    status = 409
+
+
+class Expired(StoreError):
+    """Requested RV has been compacted out of the event window (410 Gone)."""
+    status = 410
+
+
+class Invalid(StoreError):
+    status = 422
+
+
+@dataclass
+class Event:
+    """watch.Event (apimachinery pkg/watch): ADDED/MODIFIED/DELETED/BOOKMARK.
+
+    `prev_labels` carries the pre-update labels (not on the wire) so selector
+    watchers can be told when an object transitions *out* of their selector
+    set — the reference cacher synthesizes a DELETED event in that case
+    (cacher.go updateResourceVersion/dispatchEvent prevObject handling).
+    """
+    type: str
+    object: dict
+    rv: int
+    prev_labels: dict | None = None
+
+    def to_wire(self) -> dict:
+        return {"type": self.type, "object": self.object}
+
+
+@dataclass
+class _WatchChannel:
+    queue: asyncio.Queue
+    resource: str
+    namespace: str | None
+    selector: Selector | None
+    closed: bool = False
+
+
+@dataclass
+class ListResult:
+    items: list[dict]
+    resource_version: int
+
+
+# Retain this many events for watch replay before declaring RVs expired.
+# (etcd compaction analog; sized so a relisting client never loses events
+# under scheduler_perf churn.)
+DEFAULT_EVENT_WINDOW = 200_000
+BOOKMARK_INTERVAL_S = 5.0
+
+
+class MVCCStore:
+    """The store. One instance per "cluster"; resources are table names
+    ("pods", "nodes", "events", ...) — the GVR analog."""
+
+    def __init__(self, event_window: int = DEFAULT_EVENT_WINDOW):
+        # resource -> key -> object (key = "ns/name" or "name")
+        self._tables: dict[str, dict[str, dict]] = {}
+        self._rv = 0
+        # Ring of (resource, Event) for watch replay.
+        self._events: list[tuple[str, Event]] = []
+        self._event_window = event_window
+        self._first_retained_rv = 1
+        self._watchers: list[_WatchChannel] = []
+        self._bookmark_task: asyncio.Task | None = None
+        # Subresource hooks, e.g. ("pods", "binding") -> handler.
+        self._subresources: dict[tuple[str, str], Callable[..., Awaitable[dict]]] = {}
+        # Admission/validation hooks per resource, run before create/update.
+        self._validators: dict[str, list[Callable[[dict], None]]] = {}
+        self._mutators: dict[str, list[Callable[[dict], None]]] = {}
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _key(obj: Mapping) -> str:
+        ns = namespace_of(obj)
+        return f"{ns}/{name_of(obj)}" if ns else name_of(obj)
+
+    def _table(self, resource: str) -> dict[str, dict]:
+        return self._tables.setdefault(resource, {})
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    def _record(self, resource: str, ev: Event) -> None:
+        self._events.append((resource, ev))
+        if len(self._events) > self._event_window:
+            drop = len(self._events) - self._event_window
+            self._first_retained_rv = self._events[drop - 1][1].rv + 1
+            del self._events[:drop]
+        self._dispatch(resource, ev)
+
+    @staticmethod
+    def _select_event(ev: Event, selector: Selector | None) -> Event | None:
+        """Apply a label selector to an event, handling set transitions:
+        matched-before but not-after ⇒ synthesize DELETED; not-before but
+        after ⇒ ADDED (cacher.go dispatchEvent prevObject semantics)."""
+        if selector is None or not selector.requirements:
+            return ev
+        cur = selector.matches(ev.object.get("metadata", {}).get("labels"))
+        prev = (
+            selector.matches(ev.prev_labels)
+            if ev.prev_labels is not None
+            else (cur if ev.type != "ADDED" else False)
+        )
+        if ev.type == "DELETED":
+            return ev if (cur or prev) else None
+        if cur and not prev:
+            return Event("ADDED", ev.object, ev.rv, ev.prev_labels)
+        if prev and not cur:
+            return Event("DELETED", ev.object, ev.rv, ev.prev_labels)
+        return ev if cur else None
+
+    def _dispatch(self, resource: str, ev: Event) -> None:
+        for w in self._watchers:
+            if w.closed or w.resource != resource:
+                continue
+            if w.namespace and namespace_of(ev.object) != w.namespace:
+                continue
+            selected = self._select_event(ev, w.selector)
+            if selected is None:
+                continue
+            w.queue.put_nowait(selected)
+
+    def register_subresource(
+        self, resource: str, sub: str, handler: Callable[..., Awaitable[dict]]
+    ) -> None:
+        self._subresources[(resource, sub)] = handler
+
+    def register_validator(self, resource: str, fn: Callable[[dict], None]) -> None:
+        self._validators.setdefault(resource, []).append(fn)
+
+    def register_mutator(self, resource: str, fn: Callable[[dict], None]) -> None:
+        self._mutators.setdefault(resource, []).append(fn)
+
+    def _admit(self, resource: str, obj: dict) -> None:
+        for fn in self._mutators.get(resource, []):
+            fn(obj)
+        for fn in self._validators.get(resource, []):
+            fn(obj)
+
+    # -- CRUD --------------------------------------------------------------
+
+    async def create(self, resource: str, obj: Mapping) -> dict:
+        """etcd3 Create: txn If(ModRevision==0).Then(Put)."""
+        obj = deep_copy(dict(obj))
+        key = self._key(obj)
+        if not name_of(obj):
+            raise Invalid(f"{resource}: metadata.name is required")
+        table = self._table(resource)
+        if key in table:
+            raise AlreadyExists(f"{resource} {key!r} already exists")
+        self._admit(resource, obj)
+        set_creation_timestamp(obj)
+        rv = self._next_rv()
+        obj["metadata"]["resourceVersion"] = str(rv)
+        table[key] = obj
+        self._record(resource, Event("ADDED", deep_copy(obj), rv))
+        return deep_copy(obj)
+
+    async def get(self, resource: str, key: str) -> dict:
+        table = self._table(resource)
+        if key not in table:
+            raise NotFound(f"{resource} {key!r} not found")
+        return deep_copy(table[key])
+
+    async def update(self, resource: str, obj: Mapping) -> dict:
+        """Full replace with RV precondition when the object carries one."""
+        obj = deep_copy(dict(obj))
+        key = self._key(obj)
+        table = self._table(resource)
+        if key not in table:
+            raise NotFound(f"{resource} {key!r} not found")
+        current = table[key]
+        want_rv = obj.get("metadata", {}).get("resourceVersion")
+        if want_rv and want_rv != current["metadata"]["resourceVersion"]:
+            raise Conflict(
+                f"{resource} {key!r}: resourceVersion mismatch "
+                f"(have {current['metadata']['resourceVersion']}, got {want_rv})"
+            )
+        self._admit(resource, obj)
+        # Immutable metadata carries over (uid, creationTimestamp).
+        obj["metadata"]["uid"] = current["metadata"].get("uid", obj["metadata"].get("uid"))
+        obj["metadata"].setdefault(
+            "creationTimestamp", current["metadata"].get("creationTimestamp")
+        )
+        rv = self._next_rv()
+        obj["metadata"]["resourceVersion"] = str(rv)
+        prev_labels = dict(current.get("metadata", {}).get("labels") or {})
+        table[key] = obj
+        self._record(resource, Event("MODIFIED", deep_copy(obj), rv, prev_labels))
+        return deep_copy(obj)
+
+    async def guaranteed_update(
+        self, resource: str, key: str, mutate: Callable[[dict], dict | None],
+        max_retries: int = 16,
+    ) -> dict:
+        """storage.GuaranteedUpdate: read → mutate → CAS-write, retry on
+        Conflict. `mutate` gets a private copy; returning None aborts
+        (current object is returned unchanged)."""
+        for _ in range(max_retries):
+            current = await self.get(resource, key)
+            updated = mutate(deep_copy(current))
+            if updated is None:
+                return current
+            updated["metadata"]["resourceVersion"] = current["metadata"]["resourceVersion"]
+            try:
+                return await self.update(resource, updated)
+            except Conflict:
+                continue
+        raise Conflict(f"{resource} {key!r}: too many conflicts in guaranteed_update")
+
+    async def delete(self, resource: str, key: str, *, uid: str | None = None) -> dict:
+        table = self._table(resource)
+        if key not in table:
+            raise NotFound(f"{resource} {key!r} not found")
+        current = table[key]
+        if uid and current["metadata"].get("uid") != uid:
+            raise Conflict(f"{resource} {key!r}: uid precondition failed")
+        del table[key]
+        rv = self._next_rv()
+        tomb = deep_copy(current)
+        tomb["metadata"]["resourceVersion"] = str(rv)
+        self._record(resource, Event("DELETED", tomb, rv))
+        return tomb
+
+    async def list(
+        self,
+        resource: str,
+        namespace: str | None = None,
+        selector: Selector | None = None,
+        limit: int = 0,
+        continue_key: str | None = None,
+    ) -> ListResult:
+        """Consistent LIST with optional etcd-style limit/continue paging."""
+        table = self._table(resource)
+        keys = sorted(table.keys())
+        if continue_key:
+            keys = [k for k in keys if k > continue_key]
+        items: list[dict] = []
+        for k in keys:
+            obj = table[k]
+            if namespace and namespace_of(obj) != namespace:
+                continue
+            if selector is not None and not selector.matches(
+                obj.get("metadata", {}).get("labels")
+            ):
+                continue
+            items.append(deep_copy(obj))
+            if limit and len(items) >= limit:
+                break
+        return ListResult(items=items, resource_version=self._rv)
+
+    # -- WATCH -------------------------------------------------------------
+
+    async def watch(
+        self,
+        resource: str,
+        resource_version: int = 0,
+        namespace: str | None = None,
+        selector: Selector | None = None,
+        *,
+        bookmarks: bool = True,
+    ) -> AsyncIterator[Event]:
+        """Stream events after `resource_version`.
+
+        rv=0 means "from now" (reference semantics for unset RV on the cacher
+        path: start at current state — callers pair it with a LIST).
+        Raises Expired if rv predates the retained window.
+        """
+        if resource_version and resource_version + 1 < self._first_retained_rv:
+            raise Expired(
+                f"resourceVersion {resource_version} is too old "
+                f"(oldest retained: {self._first_retained_rv})"
+            )
+        chan = _WatchChannel(
+            queue=asyncio.Queue(), resource=resource,
+            namespace=namespace, selector=selector,
+        )
+        # Replay history strictly after rv, then go live. Registration happens
+        # before replay snapshot iteration completes atomically (single loop),
+        # so no event is lost between replay and live.
+        self._watchers.append(chan)
+        replay = [
+            ev for res, ev in self._events
+            if res == resource and ev.rv > resource_version
+        ] if resource_version else []
+        self._ensure_bookmarks()
+
+        async def gen() -> AsyncIterator[Event]:
+            try:
+                for ev in replay:
+                    if chan.namespace and namespace_of(ev.object) != chan.namespace:
+                        continue
+                    selected = self._select_event(ev, chan.selector)
+                    if selected is None:
+                        continue
+                    yield selected
+                # Live events queued during replay are already in chan.queue —
+                # but replayed ones may also be queued (we registered early).
+                # Skip duplicates by rv.
+                last_rv = replay[-1].rv if replay else resource_version
+                while not chan.closed:
+                    ev = await chan.queue.get()
+                    if ev.type != "BOOKMARK" and ev.rv <= last_rv:
+                        continue
+                    if not bookmarks and ev.type == "BOOKMARK":
+                        continue
+                    yield ev
+            finally:
+                chan.closed = True
+                if chan in self._watchers:
+                    self._watchers.remove(chan)
+
+        return gen()
+
+    def _ensure_bookmarks(self) -> None:
+        if self._bookmark_task is None or self._bookmark_task.done():
+            self._bookmark_task = asyncio.ensure_future(self._bookmark_loop())
+
+    async def _bookmark_loop(self) -> None:
+        """Periodic bookmark events so idle watchers learn the current RV
+        (cacher.go dispatches bookmarks ~1/min; we use 5s for test speed)."""
+        while self._watchers:
+            await asyncio.sleep(BOOKMARK_INTERVAL_S)
+            bk = Event("BOOKMARK", {"metadata": {"resourceVersion": str(self._rv)}}, self._rv)
+            for w in list(self._watchers):
+                if not w.closed:
+                    w.queue.put_nowait(bk)
+
+    def stop(self) -> None:
+        for w in self._watchers:
+            w.closed = True
+            w.queue.put_nowait(Event("BOOKMARK", {"metadata": {}}, self._rv))
+        self._watchers.clear()
+        if self._bookmark_task:
+            self._bookmark_task.cancel()
+            self._bookmark_task = None
+
+    # -- subresources ------------------------------------------------------
+
+    async def subresource(self, resource: str, key: str, sub: str, body: Mapping) -> dict:
+        handler = self._subresources.get((resource, sub))
+        if handler is None:
+            raise NotFound(f"subresource {resource}/{sub} not registered")
+        return await handler(self, key, body)
+
+    # -- persistence (WAL-lite) -------------------------------------------
+
+    def dump(self) -> str:
+        """Serialize full state (snapshot checkpoint; SURVEY §5.4: the store IS
+        the checkpoint)."""
+        return json.dumps({"rv": self._rv, "tables": self._tables})
+
+    @classmethod
+    def load(cls, data: str) -> "MVCCStore":
+        raw = json.loads(data)
+        store = cls()
+        store._rv = raw["rv"]
+        store._tables = raw["tables"]
+        store._first_retained_rv = store._rv + 1
+        return store
+
+
+# ---------------------------------------------------------------------------
+# Binding subresource (pkg/registry/core/pod/storage/storage.go BindingREST)
+# ---------------------------------------------------------------------------
+
+async def binding_subresource(store: MVCCStore, key: str, binding: Mapping) -> dict:
+    """POST pods/<key>/binding: set spec.nodeName via guaranteed update.
+
+    Fails with Conflict if the pod is already bound to a different node
+    (BindingREST.setPodHostAndAnnotations: "pod X is already assigned to node").
+    """
+    target = (binding.get("target") or {}).get("name")
+    if not target:
+        raise Invalid("binding.target.name is required")
+    want_uid = binding.get("metadata", {}).get("uid")
+
+    conflict: list[str] = []
+
+    def mutate(pod: dict) -> dict | None:
+        if want_uid and pod["metadata"].get("uid") != want_uid:
+            conflict.append("uid mismatch")
+            return None
+        cur = pod.get("spec", {}).get("nodeName")
+        if cur and cur != target:
+            conflict.append(f"pod is already assigned to node {cur!r}")
+            return None
+        pod.setdefault("spec", {})["nodeName"] = target
+        conds = pod.setdefault("status", {}).setdefault("conditions", [])
+        for c in conds:
+            if c.get("type") == "PodScheduled":
+                c["status"] = "True"
+                break
+        else:
+            conds.append({"type": "PodScheduled", "status": "True"})
+        return pod
+
+    result = await store.guaranteed_update("pods", key, mutate)
+    if conflict:
+        raise Conflict(f"binding {key!r}: {conflict[0]}")
+    return result
+
+
+def new_cluster_store() -> MVCCStore:
+    """Store with the core subresources registered."""
+    store = MVCCStore()
+    store.register_subresource("pods", "binding", binding_subresource)
+    return store

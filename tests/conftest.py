@@ -1,0 +1,31 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so mesh/sharding code paths are
+exercised without TPU hardware (the driver separately dry-runs the multi-chip
+path; real-chip perf runs happen only in bench.py).
+
+Must set XLA_FLAGS/JAX_PLATFORMS before jax initializes, hence top of conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def event_loop_policy():
+    return asyncio.DefaultEventLoopPolicy()
+
+
+def run_async(coro):
+    """Run a coroutine to completion on a fresh loop (test helper)."""
+    return asyncio.run(coro)

@@ -1,0 +1,183 @@
+"""API core tests: quantities, selectors, pod/node accessors."""
+
+import pytest
+
+from kubernetes_tpu.api.labels import (
+    Requirement,
+    from_label_selector,
+    match_label_selector,
+    match_node_selector_terms,
+    parse_selector,
+)
+from kubernetes_tpu.api.meta import (
+    deep_copy,
+    namespaced_name,
+    new_controller_ref,
+    new_object,
+)
+from kubernetes_tpu.api.resource import Quantity, format_quantity, parse_quantity
+from kubernetes_tpu.api.types import (
+    find_untolerated_taint,
+    make_node,
+    make_pod,
+    pod_requests,
+    node_allocatable,
+    toleration_tolerates_taint,
+)
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,milli",
+        [
+            ("1", 1000),
+            ("500m", 500),
+            ("0.5", 500),
+            ("2Gi", 2 * 2**30 * 1000),
+            ("1Ki", 1024 * 1000),
+            ("100k", 100_000_000),
+            ("2e3", 2_000_000),
+            ("0", 0),
+            ("", 0),
+            (None, 0),
+            (4, 4000),
+            (1.5, 1500),
+            ("250u", 0),  # rounds to 0 milli — sub-milli resolution saturates
+        ],
+    )
+    def test_parse(self, s, milli):
+        assert parse_quantity(s) == milli
+
+    @pytest.mark.parametrize("bad", ["abc", "1Qi", "--3", "1.2.3"])
+    def test_parse_errors(self, bad):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+    def test_format_roundtrip(self):
+        assert format_quantity(parse_quantity("2")) == "2"
+        assert format_quantity(parse_quantity("1500m")) == "1500m"
+        assert parse_quantity(format_quantity(parse_quantity("2Gi"))) == parse_quantity("2Gi")
+
+    def test_quantity_arith(self):
+        assert (Quantity("1") + Quantity("500m")) == Quantity("1500m")
+        assert Quantity("2Gi") > Quantity("1Gi")
+        assert Quantity("100m") - Quantity("100m") == Quantity(0)
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        sel = {"matchLabels": {"app": "web"}}
+        assert match_label_selector(sel, {"app": "web", "tier": "fe"})
+        assert not match_label_selector(sel, {"app": "db"})
+        assert not match_label_selector(sel, None)
+
+    def test_match_expressions(self):
+        sel = {
+            "matchExpressions": [
+                {"key": "env", "operator": "In", "values": ["prod", "staging"]},
+                {"key": "canary", "operator": "DoesNotExist"},
+            ]
+        }
+        assert match_label_selector(sel, {"env": "prod"})
+        assert not match_label_selector(sel, {"env": "dev"})
+        assert not match_label_selector(sel, {"env": "prod", "canary": "1"})
+
+    def test_notin_absent_key_matches(self):
+        r = Requirement("zone", "NotIn", ["a"])
+        assert r.matches({})  # reference semantics: absent key passes NotIn
+        assert not r.matches({"zone": "a"})
+        assert r.matches({"zone": "b"})
+
+    def test_gt_lt(self):
+        assert Requirement("n", "Gt", ["5"]).matches({"n": "6"})
+        assert not Requirement("n", "Gt", ["5"]).matches({"n": "5"})
+        assert Requirement("n", "Lt", ["5"]).matches({"n": "4"})
+        assert not Requirement("n", "Lt", ["5"]).matches({"n": "x"})
+
+    def test_parse_selector_grammar(self):
+        sel = parse_selector("a=b, c != d, e in (x, y), f, !g")
+        labels_ok = {"a": "b", "e": "x", "f": "1", "c": "z"}
+        assert sel.matches(labels_ok)
+        assert not sel.matches({**labels_ok, "g": "1"})
+        assert not sel.matches({**labels_ok, "c": "d"})
+        assert parse_selector("").matches({"anything": "yes"})
+
+    def test_empty_label_selector_matches_all(self):
+        assert from_label_selector({}).matches({"x": "y"})
+
+    def test_node_selector_terms_or_semantics(self):
+        terms = [
+            {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]},
+            {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["b"]}]},
+        ]
+        assert match_node_selector_terms(terms, {"zone": "b"})
+        assert not match_node_selector_terms(terms, {"zone": "c"})
+        assert not match_node_selector_terms([], {"zone": "a"})
+
+
+class TestPodNode:
+    def test_pod_requests_init_container_max(self):
+        pod = make_pod("p", requests={"cpu": "200m", "memory": "1Gi"})
+        pod["spec"]["initContainers"] = [
+            {"name": "init", "resources": {"requests": {"cpu": "1"}}}
+        ]
+        req = pod_requests(pod)
+        assert req["cpu"] == 1000  # init container max dominates 200m
+        assert req["memory"] == parse_quantity("1Gi")
+
+    def test_pod_requests_nonzero_defaults(self):
+        pod = make_pod("p")
+        req = pod_requests(pod, non_zero=True)
+        assert req["cpu"] == 100
+        assert req["memory"] == parse_quantity("200Mi")
+        assert pod_requests(pod) == {}
+
+    def test_node_allocatable(self):
+        node = make_node("n1", allocatable={"cpu": "4", "memory": "8Gi", "pods": "110"})
+        alloc = node_allocatable(node)
+        assert alloc["cpu"] == 4000
+        assert alloc["pods"] == 110_000
+
+    def test_namespaced_name(self):
+        pod = make_pod("p", namespace="ns1")
+        assert namespaced_name(pod) == "ns1/p"
+        node = make_node("n1")
+        assert namespaced_name(node) == "n1"
+
+    def test_controller_ref(self):
+        owner = new_object("ReplicaSet", "rs1", "default")
+        ref = new_controller_ref(owner)
+        assert ref["controller"] and ref["uid"] == owner["metadata"]["uid"]
+
+    def test_deep_copy_isolation(self):
+        pod = make_pod("p", labels={"a": "b"})
+        cp = deep_copy(pod)
+        cp["metadata"]["labels"]["a"] = "mutated"
+        assert pod["metadata"]["labels"]["a"] == "b"
+
+
+class TestTaints:
+    def test_exists_tolerates(self):
+        taint = {"key": "gpu", "value": "true", "effect": "NoSchedule"}
+        assert toleration_tolerates_taint({"operator": "Exists"}, taint)
+        assert toleration_tolerates_taint({"key": "gpu", "operator": "Exists"}, taint)
+        assert not toleration_tolerates_taint(
+            {"key": "gpu", "operator": "Exists", "effect": "NoExecute"}, taint
+        )
+
+    def test_equal_default_op(self):
+        taint = {"key": "k", "value": "v", "effect": "NoSchedule"}
+        assert toleration_tolerates_taint({"key": "k", "value": "v"}, taint)
+        assert not toleration_tolerates_taint({"key": "k", "value": "w"}, taint)
+
+    def test_find_untolerated(self):
+        taints = [
+            {"key": "a", "value": "1", "effect": "PreferNoSchedule"},
+            {"key": "b", "value": "2", "effect": "NoSchedule"},
+        ]
+        t = find_untolerated_taint(taints, [], ("NoSchedule", "NoExecute"))
+        assert t["key"] == "b"
+        t = find_untolerated_taint(
+            taints, [{"key": "b", "value": "2"}], ("NoSchedule", "NoExecute")
+        )
+        assert t is None

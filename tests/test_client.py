@@ -1,0 +1,175 @@
+"""Client layer tests: workqueue semantics, informer sync + handlers,
+leader election fencing."""
+
+import asyncio
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import (
+    InformerFactory,
+    LeaderElector,
+    RateLimitingQueue,
+    ResourceEventHandler,
+    WorkQueue,
+)
+from kubernetes_tpu.store import MVCCStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestWorkQueue:
+    def test_dedup_while_queued(self):
+        async def body():
+            q = WorkQueue()
+            await q.add("a")
+            await q.add("a")
+            await q.add("b")
+            assert len(q) == 2
+        run(body())
+
+    def test_requeue_while_processing(self):
+        async def body():
+            q = WorkQueue()
+            await q.add("a")
+            item, _ = await q.get()
+            assert item == "a" and len(q) == 0
+            await q.add("a")  # re-add while in flight: goes to dirty, not queue
+            assert len(q) == 0
+            await q.done("a")  # now it re-enters the queue
+            assert len(q) == 1
+        run(body())
+
+    def test_shutdown_unblocks_getters(self):
+        async def body():
+            q = WorkQueue()
+            getter = asyncio.ensure_future(q.get())
+            await asyncio.sleep(0.01)
+            await q.shut_down()
+            item, shutdown = await asyncio.wait_for(getter, 1)
+            assert shutdown and item is None
+        run(body())
+
+    def test_rate_limited_backoff_growth(self):
+        async def body():
+            q = RateLimitingQueue()
+            assert q.rate_limiter.when("x") == 0.005
+            assert q.rate_limiter.when("x") == 0.010
+            assert q.num_requeues("x") == 2
+            q.forget("x")
+            assert q.num_requeues("x") == 0
+        run(body())
+
+    def test_add_after_earlier_item_not_stuck_behind_long_delay(self):
+        async def body():
+            q = RateLimitingQueue()
+            await q.add_after("slow", 600)
+            await q.add_after("fast", 0.01)
+            import time
+            t0 = time.monotonic()
+            item, _ = await asyncio.wait_for(q.get(), 2)
+            assert item == "fast"
+            assert time.monotonic() - t0 < 1.0
+            await q.shut_down()
+        run(body())
+
+    def test_add_after_delivers(self):
+        async def body():
+            q = RateLimitingQueue()
+            await q.add_after("late", 0.02)
+            await q.add("now")
+            first, _ = await q.get()
+            assert first == "now"
+            second, _ = await asyncio.wait_for(q.get(), 1)
+            assert second == "late"
+        run(body())
+
+
+class TestInformer:
+    def test_sync_and_live_events(self):
+        async def body():
+            store = MVCCStore()
+            await store.create("nodes", make_node("n1"))
+            factory = InformerFactory(store)
+            inf = factory.informer("nodes")
+            adds, updates, deletes = [], [], []
+            inf.add_event_handler(ResourceEventHandler(
+                on_add=lambda o: adds.append(o["metadata"]["name"]),
+                on_update=lambda old, new: updates.append(new["metadata"]["name"]),
+                on_delete=lambda o: deletes.append(o["metadata"]["name"]),
+            ))
+            factory.start()
+            await factory.wait_for_sync()
+            assert adds == ["n1"]
+            assert len(inf.indexer) == 1
+
+            await store.create("nodes", make_node("n2"))
+            n1 = await store.get("nodes", "n1")
+            n1["metadata"]["labels"]["zone"] = "a"
+            await store.update("nodes", n1)
+            await store.delete("nodes", "n2")
+            await asyncio.sleep(0.05)
+            assert adds == ["n1", "n2"]
+            assert updates == ["n1"]
+            assert deletes == ["n2"]
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_late_handler_gets_synthetic_adds(self):
+        async def body():
+            store = MVCCStore()
+            await store.create("pods", make_pod("p1"))
+            factory = InformerFactory(store)
+            inf = factory.informer("pods")
+            factory.start()
+            await factory.wait_for_sync()
+            seen = []
+            inf.add_event_handler(ResourceEventHandler(
+                on_add=lambda o: seen.append(o["metadata"]["name"])))
+            assert seen == ["p1"]
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_namespace_index(self):
+        async def body():
+            store = MVCCStore()
+            await store.create("pods", make_pod("a", namespace="ns1"))
+            await store.create("pods", make_pod("b", namespace="ns2"))
+            factory = InformerFactory(store)
+            inf = factory.informer("pods")
+            factory.start()
+            await factory.wait_for_sync()
+            assert [o["metadata"]["name"] for o in inf.indexer.by_index("namespace", "ns1")] == ["a"]
+            factory.stop()
+            store.stop()
+        run(body())
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self):
+        async def body():
+            store = MVCCStore()
+            order = []
+
+            def make_payload(tag, hold):
+                async def payload():
+                    order.append(f"{tag}-start")
+                    await asyncio.sleep(hold)
+                    order.append(f"{tag}-done")
+                return payload
+
+            le1 = LeaderElector(store, "sched", "a", lease_duration=0.2,
+                                renew_deadline=0.15, retry_period=0.03)
+            le2 = LeaderElector(store, "sched", "b", lease_duration=0.2,
+                                renew_deadline=0.15, retry_period=0.03)
+            t1 = asyncio.ensure_future(le1.run(make_payload("a", 0.1)))
+            await asyncio.sleep(0.02)
+            t2 = asyncio.ensure_future(le2.run(make_payload("b", 0.1)))
+            await asyncio.wait_for(asyncio.gather(t1, t2), 5)
+            # a leads first; b only starts after a's payload finishes + lease expiry
+            assert order[0] == "a-start"
+            assert "b-start" in order
+            assert order.index("a-done") < order.index("b-start")
+        run(body())

@@ -1,0 +1,269 @@
+"""MVCC store tests: RV semantics, watch replay/bookmarks/410, CAS, binding."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.labels import parse_selector
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.store import (
+    AlreadyExists,
+    Conflict,
+    Expired,
+    Invalid,
+    MVCCStore,
+    NotFound,
+    install_core_validation,
+    new_cluster_store,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestCRUD:
+    def test_create_get_rv_monotonic(self):
+        async def body():
+            s = MVCCStore()
+            p1 = await s.create("pods", make_pod("a"))
+            p2 = await s.create("pods", make_pod("b"))
+            assert int(p2["metadata"]["resourceVersion"]) > int(p1["metadata"]["resourceVersion"])
+            got = await s.get("pods", "default/a")
+            assert got["metadata"]["name"] == "a"
+            assert got["metadata"]["creationTimestamp"]
+        run(body())
+
+    def test_create_duplicate(self):
+        async def body():
+            s = MVCCStore()
+            await s.create("pods", make_pod("a"))
+            with pytest.raises(AlreadyExists):
+                await s.create("pods", make_pod("a"))
+        run(body())
+
+    def test_update_rv_conflict(self):
+        async def body():
+            s = MVCCStore()
+            p = await s.create("pods", make_pod("a"))
+            stale = dict(p)
+            p["metadata"]["labels"] = {"x": "1"}
+            await s.update("pods", p)
+            with pytest.raises(Conflict):
+                await s.update("pods", stale)
+        run(body())
+
+    def test_guaranteed_update_retries(self):
+        async def body():
+            s = MVCCStore()
+            await s.create("pods", make_pod("a"))
+
+            async def bump(tag):
+                def mutate(pod):
+                    pod["metadata"].setdefault("annotations", {})[tag] = "1"
+                    return pod
+                return await s.guaranteed_update("pods", "default/a", mutate)
+
+            await asyncio.gather(*(bump(f"t{i}") for i in range(5)))
+            final = await s.get("pods", "default/a")
+            assert len(final["metadata"]["annotations"]) == 5
+        run(body())
+
+    def test_delete_and_uid_precondition(self):
+        async def body():
+            s = MVCCStore()
+            p = await s.create("pods", make_pod("a"))
+            with pytest.raises(Conflict):
+                await s.delete("pods", "default/a", uid="wrong")
+            tomb = await s.delete("pods", "default/a", uid=p["metadata"]["uid"])
+            assert tomb["metadata"]["name"] == "a"
+            with pytest.raises(NotFound):
+                await s.get("pods", "default/a")
+        run(body())
+
+    def test_list_selector_and_paging(self):
+        async def body():
+            s = MVCCStore()
+            for i in range(5):
+                await s.create("pods", make_pod(f"p{i}", labels={"idx": str(i % 2)}))
+            res = await s.list("pods", selector=parse_selector("idx=0"))
+            assert {p["metadata"]["name"] for p in res.items} == {"p0", "p2", "p4"}
+            page = await s.list("pods", limit=2)
+            assert len(page.items) == 2
+            rest = await s.list("pods", continue_key="default/" + page.items[-1]["metadata"]["name"])
+            assert len(rest.items) == 3
+        run(body())
+
+    def test_returned_objects_are_copies(self):
+        async def body():
+            s = MVCCStore()
+            await s.create("pods", make_pod("a", labels={"k": "v"}))
+            got = await s.get("pods", "default/a")
+            got["metadata"]["labels"]["k"] = "mutated"
+            again = await s.get("pods", "default/a")
+            assert again["metadata"]["labels"]["k"] == "v"
+        run(body())
+
+
+class TestWatch:
+    def test_watch_replay_then_live(self):
+        async def body():
+            s = MVCCStore()
+            p = await s.create("pods", make_pod("a"))
+            rv0 = int(p["metadata"]["resourceVersion"])
+            await s.create("pods", make_pod("b"))
+
+            seen = []
+            w = await s.watch("pods", resource_version=rv0)
+
+            async def consume():
+                async for ev in w:
+                    if ev.type == "BOOKMARK":
+                        continue
+                    seen.append((ev.type, ev.object["metadata"]["name"]))
+                    if len(seen) == 3:
+                        break
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.01)
+            await s.create("pods", make_pod("c"))
+            await s.delete("pods", "default/a")
+            await asyncio.wait_for(task, 2)
+            assert seen == [("ADDED", "b"), ("ADDED", "c"), ("DELETED", "a")]
+            s.stop()
+        run(body())
+
+    def test_watch_expired(self):
+        async def body():
+            s = MVCCStore(event_window=2)
+            for i in range(6):
+                await s.create("pods", make_pod(f"p{i}"))
+            with pytest.raises(Expired):
+                await s.watch("pods", resource_version=1)
+            s.stop()
+        run(body())
+
+    def test_selector_watch_sees_set_transitions(self):
+        """Relabeling an object out of a selector set must surface as DELETED
+        to selector watchers (cacher prevObject semantics); into the set as
+        ADDED."""
+        async def body():
+            s = MVCCStore()
+            p = await s.create("pods", make_pod("a", labels={"app": "web"}))
+            got = []
+            w = await s.watch("pods", resource_version=0,
+                              selector=parse_selector("app=web"))
+
+            async def consume():
+                async for ev in w:
+                    if ev.type == "BOOKMARK":
+                        continue
+                    got.append((ev.type, ev.object["metadata"]["labels"]["app"]))
+                    if len(got) == 2:
+                        break
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.01)
+            p["metadata"]["labels"]["app"] = "db"   # leaves the set → DELETED
+            p = await s.update("pods", p)
+            p["metadata"]["labels"]["app"] = "web"  # re-enters → ADDED
+            await s.update("pods", p)
+            await asyncio.wait_for(task, 2)
+            assert got == [("DELETED", "db"), ("ADDED", "web")]
+            s.stop()
+        run(body())
+
+    def test_watch_namespace_filter(self):
+        async def body():
+            s = MVCCStore()
+            w = await s.watch("pods", resource_version=0, namespace="ns1")
+            got = []
+
+            async def consume():
+                async for ev in w:
+                    got.append(ev.object["metadata"]["name"])
+                    break
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.01)
+            await s.create("pods", make_pod("other", namespace="ns2"))
+            await s.create("pods", make_pod("mine", namespace="ns1"))
+            await asyncio.wait_for(task, 2)
+            assert got == ["mine"]
+            s.stop()
+        run(body())
+
+
+class TestBinding:
+    def test_bind_sets_node_name(self):
+        async def body():
+            s = new_cluster_store()
+            pod = await s.create("pods", make_pod("a"))
+            binding = {
+                "target": {"kind": "Node", "name": "node-1"},
+                "metadata": {"uid": pod["metadata"]["uid"]},
+            }
+            bound = await s.subresource("pods", "default/a", "binding", binding)
+            assert bound["spec"]["nodeName"] == "node-1"
+            conds = {c["type"]: c["status"] for c in bound["status"]["conditions"]}
+            assert conds["PodScheduled"] == "True"
+        run(body())
+
+    def test_bind_conflict_on_rebind(self):
+        async def body():
+            s = new_cluster_store()
+            await s.create("pods", make_pod("a"))
+            await s.subresource("pods", "default/a", "binding", {"target": {"name": "n1"}})
+            with pytest.raises(Conflict):
+                await s.subresource("pods", "default/a", "binding", {"target": {"name": "n2"}})
+            # Re-binding to the same node is idempotent.
+            await s.subresource("pods", "default/a", "binding", {"target": {"name": "n1"}})
+        run(body())
+
+
+class TestValidation:
+    def test_pod_validation_and_defaults(self):
+        async def body():
+            s = new_cluster_store()
+            install_core_validation(s)
+            p = await s.create("pods", make_pod("ok"))
+            assert p["spec"]["schedulerName"] == "default-scheduler"
+            tol_keys = {t["key"] for t in p["spec"]["tolerations"]}
+            assert "node.kubernetes.io/not-ready" in tol_keys
+
+            bad = make_pod("bad")
+            bad["spec"]["containers"] = []
+            with pytest.raises(Invalid):
+                await s.create("pods", bad)
+
+            bad2 = make_pod("bad2", requests={"cpu": "2"}, limits={"cpu": "1"})
+            with pytest.raises(Invalid):
+                await s.create("pods", bad2)
+        run(body())
+
+    def test_node_validation(self):
+        async def body():
+            s = new_cluster_store()
+            install_core_validation(s)
+            await s.create("nodes", make_node("n1"))
+            bad = make_node("n2", taints=[{"key": "", "effect": "NoSchedule"}])
+            with pytest.raises(Invalid):
+                await s.create("nodes", bad)
+        run(body())
+
+
+class TestCheckpoint:
+    def test_dump_load(self):
+        async def body():
+            s = MVCCStore()
+            await s.create("pods", make_pod("a"))
+            await s.create("nodes", make_node("n1"))
+            data = s.dump()
+            s2 = MVCCStore.load(data)
+            got = await s2.get("pods", "default/a")
+            assert got["metadata"]["name"] == "a"
+            assert s2.resource_version == s.resource_version
+            # Old RVs are expired after restore (clients must relist).
+            with pytest.raises(Expired):
+                await s2.watch("pods", resource_version=1)
+        run(body())
