@@ -1,0 +1,185 @@
+"""Metrics: Prometheus-compatible counters/histograms with stability levels.
+
+Parity target: staging/src/k8s.io/component-base/metrics (registry, stability
+levels) + pkg/scheduler/metrics/metrics.go — the scheduler metric NAMES are a
+contract for dashboard parity (SURVEY §5.5) and are preserved verbatim.
+
+No prometheus_client dependency: a registry that renders the text exposition
+format is ~100 lines and keeps the zero-install constraint.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+from typing import Iterable, Mapping
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "", labels: Iterable[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] += amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            lbl = ",".join(f'{n}="{val}"' for n, val in zip(self.label_names, key))
+            lines.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
+        return "\n".join(lines)
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> str:
+        return super().render().replace("counter", "gauge", 1)
+
+
+_DEFAULT_BUCKETS = tuple(0.001 * (2 ** i) for i in range(16))  # 1ms .. ~32s
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", labels: Iterable[str] = (),
+                 buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self.buckets = buckets
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket counts (for reports/bench)."""
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        counts = self._counts.get(key)
+        total = self._totals.get(key, 0)
+        if not counts or total == 0:
+            return math.nan
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c if i == 0 else (counts[i] - counts[i - 1])
+            if cum >= rank:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+    def count(self, **labels: str) -> int:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._totals.get(key, 0)
+
+    def sum(self, **labels: str) -> float:
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return self._sums.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self._totals):
+            base = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
+            counts = self._counts[key]
+            for b, c in zip(self.buckets, counts):
+                sep = "," if base else ""
+                lines.append(f'{self.name}_bucket{{{base}{sep}le="{b}"}} {c}')
+            sep = "," if base else ""
+            lines.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {self._totals[key]}')
+            lines.append(f"{self.name}_sum{{{base}}} {self._sums[key]}")
+            lines.append(f"{self.name}_count{{{base}}} {self._totals[key]}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "", labels: Iterable[str] = ()) -> Counter:
+        if name not in self._metrics:
+            self._metrics[name] = Counter(name, help_, labels)
+        return self._metrics[name]  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "", labels: Iterable[str] = ()) -> Gauge:
+        if name not in self._metrics:
+            self._metrics[name] = Gauge(name, help_, labels)
+        return self._metrics[name]  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", labels: Iterable[str] = (),
+                  **kw) -> Histogram:
+        if name not in self._metrics:
+            self._metrics[name] = Histogram(name, help_, labels, **kw)
+        return self._metrics[name]  # type: ignore[return-value]
+
+    def render(self) -> str:
+        return "\n".join(m.render() for m in self._metrics.values()) + "\n"
+
+
+class SchedulerMetrics:
+    """The scheduler's metric contract (pkg/scheduler/metrics/metrics.go)."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or Registry()
+        self.registry = r
+        self.schedule_attempts = r.counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by result",
+            labels=("result", "profile"))
+        self.attempt_duration = r.histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency", labels=("result", "profile"))
+        self.e2e_sli_duration = r.histogram(
+            "scheduler_pod_scheduling_sli_duration_seconds",
+            "E2E pod scheduling latency incl. queue time", labels=("attempts",))
+        self.pending_pods = r.gauge(
+            "scheduler_pending_pods", "Pending pods by queue",
+            labels=("queue",))
+        self.plugin_duration = r.histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Per-plugin execution time",
+            labels=("plugin", "extension_point"))
+        self.extension_point_duration = r.histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Per-extension-point time", labels=("extension_point", "profile"))
+        self.preemption_victims = r.histogram(
+            "scheduler_preemption_victims", "Victims per preemption",
+            buckets=(1, 2, 4, 8, 16, 32, 64))
+        self.queue_incoming = r.counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to queues", labels=("event", "queue"))
+        self.goroutines = r.gauge(
+            "scheduler_goroutines", "Concurrent binding tasks", labels=("operation",))
+
+    def observe_plugin(self, plugin: str, point: str, seconds: float) -> None:
+        self.plugin_duration.observe(seconds, plugin=plugin, extension_point=point)
+
+    def observe_attempt(self, result: str, profile: str, seconds: float) -> None:
+        self.schedule_attempts.inc(result=result, profile=profile)
+        self.attempt_duration.observe(seconds, result=result, profile=profile)
+
+    def set_pending(self, stats: Mapping[str, int]) -> None:
+        for queue, n in stats.items():
+            self.pending_pods.set(n, queue=queue)

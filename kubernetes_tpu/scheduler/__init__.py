@@ -1,0 +1,29 @@
+"""The scheduler: framework extension points, 3-tier queue, assume/expire
+cache, in-tree plugins, and the batched TPU execution backend."""
+
+from kubernetes_tpu.scheduler.framework import (
+    CycleState,
+    Framework,
+    Plugin,
+    Status,
+)
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.queue import ClusterEvent, SchedulingQueue
+from kubernetes_tpu.scheduler.scheduler import FitError, Scheduler
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Resource, Snapshot
+
+__all__ = [
+    "CycleState",
+    "Framework",
+    "Plugin",
+    "Status",
+    "SchedulerCache",
+    "ClusterEvent",
+    "SchedulingQueue",
+    "FitError",
+    "Scheduler",
+    "NodeInfo",
+    "PodInfo",
+    "Resource",
+    "Snapshot",
+]

@@ -1,0 +1,192 @@
+"""Scheduler cache: in-memory mirror of nodes+pods with assume/confirm/expire
+and incremental generation-based snapshots.
+
+Parity target: pkg/scheduler/internal/cache/cache.go (`cacheImpl`:
+`AssumePod`, `FinishBinding`, `ForgetPod`, `AddPod`, `RemovePod`,
+`AddNode`/`UpdateNode`/`RemoveNode`, `UpdateSnapshot` — generation-numbered
+incremental copy; assumed pods expire after a TTL (`durationToExpireAssumedPod`,
+default 15 min, 0 = never) unless confirmed by observing the bound pod).
+
+The assume protocol is what lets binding be asynchronous: the cycle writes the
+assumed pod into the cache *optimistically* so the next cycle's snapshot sees
+its resources as taken; the informer later confirms (AddPod for the bound pod)
+or the TTL expires it (bind failed and nobody told us).
+
+Batched-pop deviation: assume() is called for every pod in a solver batch
+before any binding starts — intra-batch contention is already resolved inside
+the solver, so assumes cannot conflict (SURVEY §3.1 note).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Mapping
+
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+logger = logging.getLogger(__name__)
+
+
+class SchedulerCache:
+    def __init__(self, assumed_pod_ttl: float = 900.0):
+        self.nodes: dict[str, NodeInfo] = {}
+        # pod key -> (PodInfo, node_name, assumed, finished_binding, deadline)
+        self._pod_states: dict[str, dict] = {}
+        self.assumed_pod_ttl = assumed_pod_ttl
+        self._generation = 0
+        # Snapshot bookkeeping: cached NodeInfo clones by name + the
+        # generation they were copied at.
+        self._snap_nodes: dict[str, NodeInfo] = {}
+        self._snap_generation = -1
+
+    def _bump(self, node: NodeInfo) -> None:
+        self._generation += 1
+        node.generation = self._generation
+
+    # -- nodes -------------------------------------------------------------
+
+    def add_node(self, node: Mapping) -> None:
+        name = node["metadata"]["name"]
+        ni = self.nodes.get(name)
+        if ni is None:
+            ni = NodeInfo(node)
+            self.nodes[name] = ni
+        else:
+            ni.set_node(node)
+        self._bump(ni)
+
+    def update_node(self, node: Mapping) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        self.nodes.pop(name, None)
+        self._snap_nodes.pop(name, None)
+        self._generation += 1
+        self._snap_generation = -1  # force full re-snapshot on deletion
+
+    # -- pods --------------------------------------------------------------
+
+    def assume_pod(self, pi: PodInfo, node_name: str) -> None:
+        if pi.key in self._pod_states:
+            raise ValueError(f"pod {pi.key} already assumed/added")
+        ni = self.nodes.get(node_name)
+        if ni is None:
+            raise KeyError(f"assume: unknown node {node_name}")
+        ni.add_pod(pi)
+        self._bump(ni)
+        self._pod_states[pi.key] = {
+            "pod": pi, "node": node_name, "assumed": True,
+            "finished": False, "deadline": None,
+        }
+
+    def finish_binding(self, pod_key: str, now: float | None = None) -> None:
+        st = self._pod_states.get(pod_key)
+        if st is None or not st["assumed"]:
+            return
+        st["finished"] = True
+        if self.assumed_pod_ttl > 0:
+            st["deadline"] = (now or time.monotonic()) + self.assumed_pod_ttl
+
+    def forget_pod(self, pod_key: str) -> None:
+        """Undo an assume (bind failed)."""
+        st = self._pod_states.pop(pod_key, None)
+        if st is None:
+            return
+        ni = self.nodes.get(st["node"])
+        if ni is not None:
+            ni.remove_pod(pod_key)
+            self._bump(ni)
+
+    def add_pod(self, pi: PodInfo) -> None:
+        """Informer confirms a bound pod. If it was assumed: confirm (or move
+        if the API says a different node than we assumed)."""
+        st = self._pod_states.get(pi.key)
+        if st is not None and st["assumed"]:
+            if st["node"] != pi.node_name:
+                logger.warning("pod %s assumed on %s but bound to %s; correcting",
+                               pi.key, st["node"], pi.node_name)
+                self.forget_pod(pi.key)
+                self._add_confirmed(pi)
+            else:
+                st["assumed"] = False
+                st["deadline"] = None
+                st["pod"] = pi
+            return
+        if st is not None:
+            return  # duplicate add
+        self._add_confirmed(pi)
+
+    def _add_confirmed(self, pi: PodInfo) -> None:
+        ni = self.nodes.get(pi.node_name)
+        if ni is None:
+            # Pod bound to a node we haven't seen yet: create a placeholder
+            # (the reference tolerates this ordering with an imaginary node).
+            ni = NodeInfo()
+            ni.name = pi.node_name
+            self.nodes[pi.node_name] = ni
+        ni.add_pod(pi)
+        self._bump(ni)
+        self._pod_states[pi.key] = {
+            "pod": pi, "node": pi.node_name, "assumed": False,
+            "finished": True, "deadline": None,
+        }
+
+    def update_pod(self, pi: PodInfo) -> None:
+        st = self._pod_states.get(pi.key)
+        if st is None:
+            if pi.node_name:
+                self.add_pod(pi)
+            return
+        ni = self.nodes.get(st["node"])
+        if ni is not None:
+            ni.remove_pod(pi.key)
+            self._bump(ni)
+        del self._pod_states[pi.key]
+        if pi.node_name:
+            self.add_pod(pi)
+
+    def remove_pod(self, pod_key: str) -> None:
+        st = self._pod_states.pop(pod_key, None)
+        if st is None:
+            return
+        ni = self.nodes.get(st["node"])
+        if ni is not None:
+            ni.remove_pod(pod_key)
+            self._bump(ni)
+
+    def is_assumed(self, pod_key: str) -> bool:
+        st = self._pod_states.get(pod_key)
+        return bool(st and st["assumed"])
+
+    def cleanup_expired(self, now: float | None = None) -> list[str]:
+        """Expire assumed-and-finished pods past their TTL
+        (cleanupAssumedPods, run periodically)."""
+        now = now or time.monotonic()
+        expired = [
+            k for k, st in self._pod_states.items()
+            if st["assumed"] and st["finished"]
+            and st["deadline"] is not None and st["deadline"] <= now
+        ]
+        for k in expired:
+            logger.warning("assumed pod %s expired without confirmation", k)
+            self.forget_pod(k)
+        return expired
+
+    # -- snapshot ----------------------------------------------------------
+
+    def update_snapshot(self) -> Snapshot:
+        """Incremental snapshot: only nodes whose generation advanced since
+        the last snapshot are re-cloned (UpdateSnapshot's generation walk)."""
+        for name, ni in self.nodes.items():
+            cached = self._snap_nodes.get(name)
+            if cached is None or cached.generation != ni.generation:
+                self._snap_nodes[name] = ni.clone()
+        for name in list(self._snap_nodes):
+            if name not in self.nodes:
+                del self._snap_nodes[name]
+        self._snap_generation = self._generation
+        return Snapshot(list(self._snap_nodes.values()), self._generation)
+
+    def pod_count(self) -> int:
+        return len(self._pod_states)

@@ -1,0 +1,94 @@
+"""DefaultPreemption: PostFilter that evicts lower-priority victims.
+
+Parity target: pkg/scheduler/framework/preemption/preemption.go
+(`Evaluator.Preempt`: find candidates → pick min-cost node → delete victims →
+set status.nominatedNodeName) + plugins/defaultpreemption/default_preemption.go
+(`SelectVictimsOnNode`: dry-run removing lower-priority pods, re-run Filter,
+add back as many as possible in priority order; `pickOneNodeForPreemption`
+ordering: fewest PDB violations → lowest max victim priority → smallest
+priority sum → fewest victims → latest start time).
+
+The dry-run uses cloned NodeInfo so the live snapshot is untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from kubernetes_tpu.scheduler.framework import (
+    CycleState,
+    Plugin,
+    Status,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+)
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+
+class DefaultPreemption(Plugin):
+    NAME = "DefaultPreemption"
+    EXTENSION_POINTS = ("PostFilter",)
+
+    def __init__(self, args=None, framework=None, evict=None):
+        """`framework` runs the Filter dry-runs; `evict(pod_key, victim_keys,
+        node)` is the side-effect callback the scheduler injects (API deletes
+        + nominatedNodeName patch happen there)."""
+        super().__init__(args)
+        self.framework = framework
+        self.evict = evict
+
+    def post_filter(self, state: CycleState, pod: PodInfo, snapshot: Snapshot,
+                    filtered_status: Mapping[str, Status]) -> tuple[str, Status]:
+        if self.framework is None:
+            return "", Status.unschedulable()
+        # Nodes rejected as UnschedulableAndUnresolvable can't be helped by
+        # preemption (preemption.go `nodesWherePreemptionMightHelp`).
+        candidates: list[tuple[str, list[PodInfo]]] = []
+        for node in snapshot:
+            st = filtered_status.get(node.name)
+            if st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue
+            victims = self._select_victims(state, pod, node)
+            if victims is not None:
+                candidates.append((node.name, victims))
+        if not candidates:
+            return "", Status.unschedulable(
+                "preemption: 0/%d nodes are available" % len(snapshot))
+        node_name, victims = self._pick_one(candidates)
+        if self.evict is not None:
+            self.evict(pod, [v.key for v in victims], node_name)
+        return node_name, Status.success()
+
+    def _select_victims(self, state: CycleState, pod: PodInfo,
+                        node: NodeInfo) -> list[PodInfo] | None:
+        """Dry-run: remove ALL lower-priority pods; if pod fits, add back as
+        many as possible (highest priority first), keeping feasibility."""
+        lower = [p for p in node.pods if p.priority < pod.priority]
+        if not lower:
+            return None
+        dry = node.clone()
+        for v in lower:
+            dry.remove_pod(v.key)
+        if not self.framework.run_filters(state.clone(), pod, dry).is_success():
+            return None
+        # Reprieve pass: re-add in priority-desc order while still feasible.
+        victims: list[PodInfo] = []
+        for v in sorted(lower, key=lambda p: -p.priority):
+            dry.add_pod(v)
+            if self.framework.run_filters(state.clone(), pod, dry).is_success():
+                continue  # reprieved
+            dry.remove_pod(v.key)
+            victims.append(v)
+        return victims if victims else None
+
+    @staticmethod
+    def _pick_one(candidates: list[tuple[str, list[PodInfo]]]) -> tuple[str, list[PodInfo]]:
+        """pickOneNodeForPreemption cost ordering (no PDB tier yet —
+        disruption controller integration adds it)."""
+        def cost(entry):
+            _, victims = entry
+            return (
+                max((v.priority for v in victims), default=0),
+                sum(v.priority for v in victims),
+                len(victims),
+            )
+        return min(candidates, key=cost)

@@ -1,0 +1,96 @@
+"""In-tree plugin registry (plugins/registry.go `NewInTreeRegistry`)."""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+from kubernetes_tpu.scheduler.plugins.core import (
+    DefaultBinder,
+    ImageLocality,
+    PrioritySort,
+    SchedulingGates,
+)
+from kubernetes_tpu.scheduler.plugins.defaultpreemption import DefaultPreemption
+from kubernetes_tpu.scheduler.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.scheduler.plugins.nodeaffinity import (
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    TaintToleration,
+)
+from kubernetes_tpu.scheduler.plugins.noderesources import (
+    BalancedAllocation,
+    NodeResourcesFit,
+)
+from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
+
+#: name -> factory(args) (framework/runtime Registry)
+IN_TREE: dict[str, Callable] = {
+    "PrioritySort": PrioritySort,
+    "SchedulingGates": SchedulingGates,
+    "NodeResourcesFit": NodeResourcesFit,
+    "NodeResourcesBalancedAllocation": BalancedAllocation,
+    "NodeAffinity": NodeAffinity,
+    "NodeName": NodeName,
+    "NodeUnschedulable": NodeUnschedulable,
+    "TaintToleration": TaintToleration,
+    "NodePorts": NodePorts,
+    "InterPodAffinity": InterPodAffinity,
+    "PodTopologySpread": PodTopologySpread,
+    "ImageLocality": ImageLocality,
+    "DefaultPreemption": DefaultPreemption,
+    "DefaultBinder": DefaultBinder,
+}
+
+#: Default enabled set (the reference's default-plugins profile).
+DEFAULT_PLUGINS = [
+    "PrioritySort",
+    "SchedulingGates",
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodePorts",
+    "NodeResourcesFit",
+    "NodeResourcesBalancedAllocation",
+    "InterPodAffinity",
+    "PodTopologySpread",
+    "ImageLocality",
+    "DefaultPreemption",
+    "DefaultBinder",
+]
+
+#: Default score weights (defaults.go: NodeResourcesFit=1, Balanced=1,
+#: InterPodAffinity=1 (hard weight separate), PodTopologySpread=2, ...).
+DEFAULT_SCORE_WEIGHTS = {
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "NodeAffinity": 2,
+    "InterPodAffinity": 2,
+    "PodTopologySpread": 2,
+    "TaintToleration": 3,
+    "ImageLocality": 1,
+}
+
+
+def build_plugins(
+    enabled: list[str] | None = None,
+    plugin_config: Mapping[str, Mapping] | None = None,
+    store=None,
+) -> list:
+    """Instantiate plugins by name with per-plugin args
+    (KubeSchedulerConfiguration pluginConfig)."""
+    enabled = enabled or DEFAULT_PLUGINS
+    plugin_config = plugin_config or {}
+    out = []
+    for name in enabled:
+        factory = IN_TREE.get(name)
+        if factory is None:
+            raise KeyError(f"unknown plugin {name!r}")
+        args = plugin_config.get(name)
+        if name == "DefaultBinder":
+            out.append(factory(args, store=store))
+        else:
+            out.append(factory(args))
+    return out

@@ -1,0 +1,532 @@
+"""The scheduler: queue → cycle → assume → (async) bind.
+
+Parity target: pkg/scheduler/scheduler.go + schedule_one.go
+(`Scheduler.Run` → `ScheduleOne`; `schedulingCycle` (synchronous hot path:
+snapshot → PreFilter → findNodesThatFitPod → prioritizeNodes → selectHost →
+assume → Reserve → Permit) and `bindingCycle` (async task: WaitOnPermit →
+PreBind → Bind → PostBind)); eventhandlers.go (`addAllEventHandlers`).
+
+Two execution modes share every seam:
+
+- `run_one()` — the reference-shaped one-pod-per-cycle loop (the oracle).
+- `run_batched(max_batch=P)` — drains up to P pods per cycle and hands the
+  whole batch to a backend (host greedy or the TPU solver); intra-batch
+  resource contention is resolved by the backend before any assume happens.
+
+`percentageOfNodesToScore` is honored on the host path for parity
+(numFeasibleNodesToFind: adaptive 50 - N/125, floor 5%); the TPU path
+defaults it to 100% because full-N is one tensor op (SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Mapping
+
+from kubernetes_tpu.api.meta import namespaced_name
+from kubernetes_tpu.api.types import pod_is_terminal
+from kubernetes_tpu.client import EventRecorder, InformerFactory, ResourceEventHandler
+from kubernetes_tpu.metrics.registry import SchedulerMetrics
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.framework import (
+    CycleState,
+    Framework,
+    Status,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+)
+from kubernetes_tpu.scheduler.plugins.defaultpreemption import DefaultPreemption
+from kubernetes_tpu.scheduler.plugins.registry import (
+    DEFAULT_SCORE_WEIGHTS,
+    build_plugins,
+)
+from kubernetes_tpu.scheduler.queue import ClusterEvent, SchedulingQueue
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+logger = logging.getLogger(__name__)
+
+
+class FitError(Exception):
+    def __init__(self, pod: PodInfo, num_nodes: int, statuses: Mapping[str, Status]):
+        self.pod = pod
+        self.num_nodes = num_nodes
+        self.statuses = statuses
+        reasons: dict[str, int] = {}
+        for st in statuses.values():
+            for r in st.reasons:
+                reasons[r] = reasons.get(r, 0) + 1
+        msg = ", ".join(f"{n} {r}" for r, n in sorted(reasons.items()))
+        super().__init__(
+            f"0/{num_nodes} nodes are available: {msg}" if msg
+            else f"0/{num_nodes} nodes are available")
+
+
+class ScheduleResult:
+    __slots__ = ("node", "evaluated", "feasible")
+
+    def __init__(self, node: str, evaluated: int, feasible: int):
+        self.node = node
+        self.evaluated = evaluated
+        self.feasible = feasible
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store,
+        profiles: Mapping[str, Framework] | None = None,
+        percentage_of_nodes_to_score: int = 0,
+        seed: int = 0,
+        metrics: SchedulerMetrics | None = None,
+        backend=None,
+        pod_initial_backoff: float = 1.0,
+        pod_max_backoff: float = 10.0,
+    ):
+        self.store = store
+        self.metrics = metrics or SchedulerMetrics()
+        if profiles is None:
+            plugins = build_plugins(store=store)
+            fwk = Framework(plugins, DEFAULT_SCORE_WEIGHTS, metrics=self.metrics)
+            profiles = {"default-scheduler": fwk}
+        self.profiles = dict(profiles)
+        for fwk in self.profiles.values():
+            if fwk.metrics is None:
+                fwk.metrics = self.metrics
+            for p in fwk.post_filter_plugins:
+                if isinstance(p, DefaultPreemption):
+                    p.framework = fwk
+                    if p.evict is None:
+                        p.evict = self._preemption_evict
+        self.cache = SchedulerCache()
+        default_fwk = next(iter(self.profiles.values()))
+        self.queue = SchedulingQueue(
+            default_fwk, initial_backoff=pod_initial_backoff,
+            max_backoff=pod_max_backoff)
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.rng = random.Random(seed)
+        self.backend = backend  # TPU batch backend; None = host path
+        self.extenders: list = []
+        self.recorder = EventRecorder(store, "default-scheduler")
+        self._informer_factory: InformerFactory | None = None
+        self._binding_tasks: set[asyncio.Task] = set()
+        self._permit_waiters: dict[str, asyncio.Future] = {}
+        self._stop = False
+        self._register_default_hints(default_fwk)
+
+    # ------------------------------------------------------------------
+    # wiring (eventhandlers.go addAllEventHandlers)
+    # ------------------------------------------------------------------
+
+    def _register_default_hints(self, fwk: Framework) -> None:
+        for plugin in fwk.plugins:
+            for label in getattr(plugin, "EVENTS", []):
+                self.queue.register_hint(
+                    label, plugin.NAME, lambda pi, ev: "Queue")
+
+    async def setup_informers(self, factory: InformerFactory) -> None:
+        self._informer_factory = factory
+        pods = factory.informer("pods")
+        nodes = factory.informer("nodes")
+
+        def on_pod_add(obj):
+            pi = PodInfo(obj)
+            if pod_is_terminal(obj):
+                return
+            if pi.node_name:
+                self.cache.add_pod(pi)
+                asyncio.ensure_future(
+                    self.queue.move_all(ClusterEvent("Pod", "Add")))
+            elif self._responsible(pi):
+                asyncio.ensure_future(self.queue.add(pi))
+
+        def on_pod_update(old, new):
+            pi = PodInfo(new)
+            if pod_is_terminal(new):
+                on_pod_delete(new)
+                return
+            if pi.node_name:
+                self.cache.update_pod(pi)
+            elif self._responsible(pi):
+                # Covers the SchedulingGates-removal path too: queue.update
+                # re-runs PreEnqueue on the fresh object.
+                asyncio.ensure_future(self.queue.update(pi))
+
+        def on_pod_delete(obj):
+            key = namespaced_name(obj)
+            if obj.get("spec", {}).get("nodeName") or self.cache.is_assumed(key):
+                self.cache.remove_pod(key)
+            asyncio.ensure_future(self.queue.delete(key))
+            asyncio.ensure_future(
+                self.queue.move_all(ClusterEvent("Pod", "Delete")))
+
+        def on_node_add(obj):
+            self.cache.add_node(obj)
+            asyncio.ensure_future(
+                self.queue.move_all(ClusterEvent("Node", "Add")))
+
+        def on_node_update(old, new):
+            self.cache.update_node(new)
+            asyncio.ensure_future(
+                self.queue.move_all(ClusterEvent("Node", "Update")))
+
+        def on_node_delete(obj):
+            self.cache.remove_node(obj["metadata"]["name"])
+
+        pods.add_event_handler(ResourceEventHandler(
+            on_add=on_pod_add, on_update=on_pod_update, on_delete=on_pod_delete))
+        nodes.add_event_handler(ResourceEventHandler(
+            on_add=on_node_add, on_update=on_node_update, on_delete=on_node_delete))
+
+    def _responsible(self, pi: PodInfo) -> bool:
+        return pi.scheduler_name in self.profiles
+
+    # ------------------------------------------------------------------
+    # scheduling cycle (host path)
+    # ------------------------------------------------------------------
+
+    def _num_feasible_nodes_to_find(self, num_nodes: int) -> int:
+        """numFeasibleNodesToFind: adaptive percentage sampling."""
+        if num_nodes < 100 or self.percentage_of_nodes_to_score >= 100:
+            return num_nodes
+        pct = self.percentage_of_nodes_to_score
+        if pct <= 0:
+            pct = max(50 - num_nodes // 125, 5)
+        return max(num_nodes * pct // 100, 100)
+
+    def find_nodes_that_fit(
+        self, fwk: Framework, state: CycleState, pod: PodInfo, snapshot: Snapshot,
+    ) -> tuple[list[NodeInfo], dict[str, Status]]:
+        """findNodesThatFitPod: PreFilter → Filter each node (+ extenders)."""
+        statuses: dict[str, Status] = {}
+        st = fwk.run_pre_filter(state, pod, snapshot)
+        if not st.is_success():
+            if st.is_unschedulable():
+                for n in snapshot:
+                    statuses[n.name] = st
+                return [], statuses
+            raise RuntimeError(f"PreFilter error: {st.message()}")
+
+        # Nominated-node fast path (preemptor pods retry their nominee first).
+        if pod.nominated_node:
+            ni = snapshot.get(pod.nominated_node)
+            if ni is not None and fwk.run_filters(state, pod, ni).is_success():
+                return [ni], statuses
+
+        want = self._num_feasible_nodes_to_find(len(snapshot))
+        feasible: list[NodeInfo] = []
+        # Round-robin start offset mirrors nextStartNodeIndex fairness.
+        start = self.rng.randrange(len(snapshot)) if len(snapshot) else 0
+        nodes = snapshot.nodes
+        for i in range(len(nodes)):
+            node = nodes[(start + i) % len(nodes)]
+            st = fwk.run_filters(state, pod, node)
+            if st.is_success():
+                feasible.append(node)
+                if len(feasible) >= want:
+                    break
+            else:
+                statuses[node.name] = st
+        for ext in self.extenders:
+            if not feasible:
+                break
+            feasible, failed = ext.filter(pod, feasible)
+            for name, reason in failed.items():
+                statuses[name] = Status.unschedulable(reason).with_plugin(ext.name)
+        return feasible, statuses
+
+    def prioritize_nodes(
+        self, fwk: Framework, state: CycleState, pod: PodInfo,
+        nodes: list[NodeInfo],
+    ) -> dict[str, float]:
+        st = fwk.run_pre_score(state, pod, nodes)
+        if not st.is_success():
+            raise RuntimeError(f"PreScore error: {st.message()}")
+        scores = fwk.run_scores(state, pod, nodes)
+        for ext in self.extenders:
+            for name, s in ext.prioritize(pod, nodes).items():
+                scores[name] = scores.get(name, 0.0) + s
+        return scores
+
+    def select_host(self, scores: Mapping[str, float]) -> str:
+        """selectHost: max score with reservoir-sampled random tiebreak
+        (seeded rng — SURVEY §4 carry-in #5)."""
+        best = None
+        best_score = float("-inf")
+        count = 0
+        for name, s in scores.items():
+            if s > best_score:
+                best, best_score, count = name, s, 1
+            elif s == best_score:
+                count += 1
+                if self.rng.randrange(count) == 0:
+                    best = name
+        return best or ""
+
+    def schedule_pod(self, fwk: Framework, state: CycleState, pod: PodInfo,
+                     snapshot: Snapshot) -> ScheduleResult:
+        if len(snapshot) == 0:
+            raise FitError(pod, 0, {})
+        feasible, statuses = self.find_nodes_that_fit(fwk, state, pod, snapshot)
+        if not feasible:
+            raise FitError(pod, len(snapshot), statuses)
+        if len(feasible) == 1:
+            return ScheduleResult(feasible[0].name,
+                                  len(statuses) + 1, 1)
+        scores = self.prioritize_nodes(fwk, state, pod, feasible)
+        host = self.select_host(scores)
+        return ScheduleResult(host, len(statuses) + len(feasible), len(feasible))
+
+    # ------------------------------------------------------------------
+    # the loop
+    # ------------------------------------------------------------------
+
+    async def schedule_one(self) -> bool:
+        """One pod, full cycle. Returns False when queue closed."""
+        pods = await self.queue.pop_batch(1)
+        if not pods:
+            return False
+        await self._schedule_pods(pods)
+        return True
+
+    async def schedule_batch(self, max_batch: int) -> bool:
+        pods = await self.queue.pop_batch(max_batch)
+        if not pods:
+            return False
+        await self._schedule_pods(pods)
+        return True
+
+    async def _schedule_pods(self, pods: list[PodInfo]) -> None:
+        snapshot = self.cache.update_snapshot()
+        if self.backend is not None and len(pods) > 1:
+            # Pods are batched per profile: each batch runs under its own
+            # plugin set/weights (profiles are keyed by schedulerName).
+            by_profile: dict[str, list[PodInfo]] = {}
+            for pi in pods:
+                by_profile.setdefault(pi.scheduler_name, []).append(pi)
+            for group in by_profile.values():
+                await self._schedule_via_backend(group, snapshot)
+                snapshot = self.cache.update_snapshot()
+            return
+        for pi in pods:
+            await self._schedule_host_path(pi, snapshot)
+            # Re-snapshot so pods later in the batch see earlier assumes.
+            snapshot = self.cache.update_snapshot()
+
+    async def _schedule_via_backend(self, pods: list[PodInfo], snapshot) -> None:
+        """Batched path: the backend returns {pod_key: node_name | None}."""
+        fwk = self.profiles.get(pods[0].scheduler_name) or next(iter(self.profiles.values()))
+        t0 = time.perf_counter()
+        assignments, diagnostics = self.backend.assign(pods, snapshot, fwk)
+        elapsed = time.perf_counter() - t0
+        for pi in pods:
+            node = assignments.get(pi.key)
+            if node:
+                self.metrics.observe_attempt("scheduled", fwk.profile_name, elapsed / len(pods))
+                await self._assume_and_bind(fwk, CycleState(), pi, node)
+            else:
+                self.metrics.observe_attempt("unschedulable", fwk.profile_name,
+                                             elapsed / len(pods))
+                statuses = diagnostics.get(pi.key, {})
+                await self._handle_failure(
+                    fwk, pi, FitError(pi, len(snapshot), statuses), statuses)
+
+    async def _schedule_host_path(self, pi: PodInfo, snapshot) -> None:
+        fwk = self.profiles.get(pi.scheduler_name)
+        if fwk is None:
+            logger.error("no profile for schedulerName=%s", pi.scheduler_name)
+            await self.queue.done(pi.key)
+            return
+        state = CycleState()
+        t0 = time.perf_counter()
+        try:
+            result = self.schedule_pod(fwk, state, pi, snapshot)
+        except FitError as fe:
+            self.metrics.observe_attempt("unschedulable", fwk.profile_name,
+                                         time.perf_counter() - t0)
+            await self._handle_failure(fwk, pi, fe, fe.statuses, state=state,
+                                       snapshot=snapshot)
+            return
+        except Exception as e:  # infrastructure error
+            logger.exception("scheduling cycle error for %s", pi.key)
+            self.metrics.observe_attempt("error", fwk.profile_name,
+                                         time.perf_counter() - t0)
+            await self.queue.move_to_backoff(pi)
+            return
+        self.metrics.observe_attempt("scheduled", fwk.profile_name,
+                                     time.perf_counter() - t0)
+        await self._assume_and_bind(fwk, state, pi, result.node)
+
+    async def _assume_and_bind(self, fwk: Framework, state: CycleState,
+                               pi: PodInfo, node_name: str) -> None:
+        """assume → Reserve → Permit → async bindingCycle."""
+        try:
+            self.cache.assume_pod(pi, node_name)
+        except (KeyError, ValueError) as e:
+            logger.error("assume failed for %s: %s", pi.key, e)
+            await self.queue.move_to_backoff(pi)
+            return
+        st = fwk.run_reserve(state, pi, node_name)
+        if not st.is_success():
+            self.cache.forget_pod(pi.key)
+            await self._requeue_unschedulable(pi, st)
+            return
+        permit_status, timeout = fwk.run_permit(state, pi, node_name)
+        if not permit_status.is_success() and not permit_status.is_wait():
+            fwk.run_unreserve(state, pi, node_name)
+            self.cache.forget_pod(pi.key)
+            await self._requeue_unschedulable(pi, permit_status)
+            return
+        task = asyncio.ensure_future(
+            self._binding_cycle(fwk, state, pi, node_name, permit_status, timeout))
+        self._binding_tasks.add(task)
+        task.add_done_callback(self._binding_tasks.discard)
+        self.metrics.goroutines.set(len(self._binding_tasks), operation="binding")
+
+    async def _binding_cycle(self, fwk: Framework, state: CycleState, pi: PodInfo,
+                             node_name: str, permit_status: Status,
+                             timeout: float) -> None:
+        bound = False
+        try:
+            if permit_status.is_wait():
+                ok = await self._wait_on_permit(fwk, pi, timeout)
+                if not ok:
+                    fwk.run_unreserve(state, pi, node_name)
+                    self.cache.forget_pod(pi.key)
+                    await self._requeue_unschedulable(
+                        pi, Status.unschedulable("rejected at Permit"))
+                    return
+            st = await fwk.run_pre_bind(state, pi, node_name)
+            if not st.is_success():
+                fwk.run_unreserve(state, pi, node_name)
+                self.cache.forget_pod(pi.key)
+                await self._requeue_unschedulable(pi, st)
+                return
+            st = await fwk.run_bind(state, pi, node_name)
+            if not st.is_success():
+                fwk.run_unreserve(state, pi, node_name)
+                self.cache.forget_pod(pi.key)
+                await self._requeue_unschedulable(pi, st)
+                return
+            # The pod is durably bound in the API from here on: failures
+            # below must NOT forget/requeue it (it is genuinely scheduled).
+            bound = True
+            self.cache.finish_binding(pi.key)
+            fwk.run_post_bind(state, pi, node_name)
+            self.recorder.event(pi.pod, "Normal", "Scheduled",
+                                f"Successfully assigned {pi.key} to {node_name}")
+            await self.queue.done(pi.key)
+        except Exception:
+            logger.exception("binding cycle crashed for %s", pi.key)
+            if bound:
+                await self.queue.done(pi.key)
+                return
+            self.cache.forget_pod(pi.key)
+            await self.queue.move_to_backoff(pi)
+
+    # Permit wait support (gang scheduling parks here) ------------------
+
+    def allow_waiting_pod(self, pod_key: str) -> None:
+        fut = self._permit_waiters.get(pod_key)
+        if fut and not fut.done():
+            fut.set_result(True)
+
+    def reject_waiting_pod(self, pod_key: str) -> None:
+        fut = self._permit_waiters.get(pod_key)
+        if fut and not fut.done():
+            fut.set_result(False)
+
+    async def _wait_on_permit(self, fwk: Framework, pi: PodInfo,
+                              timeout: float) -> bool:
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._permit_waiters[pi.key] = fut
+        try:
+            return await asyncio.wait_for(fut, timeout if timeout > 0 else None)
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            self._permit_waiters.pop(pi.key, None)
+
+    # Failure handling --------------------------------------------------
+
+    async def _handle_failure(self, fwk: Framework, pi: PodInfo, err: FitError,
+                              statuses: Mapping[str, Status],
+                              state: CycleState | None = None,
+                              snapshot=None) -> None:
+        """handleSchedulingFailure: record reasons, try preemption, requeue."""
+        pi.last_failure = str(err)
+        pi.unschedulable_plugins = {
+            st.plugin for st in statuses.values() if st.plugin}
+        self.recorder.event(pi.pod, "Warning", "FailedScheduling", str(err))
+        resolvable = any(
+            st.code != UNSCHEDULABLE_AND_UNRESOLVABLE for st in statuses.values()
+        ) or not statuses
+        if resolvable and state is not None and snapshot is not None \
+                and fwk.post_filter_plugins:
+            nominated, st = fwk.run_post_filters(state, pi, snapshot, statuses)
+            if st.is_success() and nominated:
+                pi.nominated_node = nominated
+                self.metrics.schedule_attempts.inc(
+                    result="preemption", profile=fwk.profile_name)
+        await self.queue.add_unschedulable(pi)
+
+    async def _requeue_unschedulable(self, pi: PodInfo, st: Status) -> None:
+        pi.last_failure = st.message()
+        self.recorder.event(pi.pod, "Warning", "FailedScheduling", st.message())
+        await self.queue.add_unschedulable(pi)
+
+    def _preemption_evict(self, pod: PodInfo, victim_keys: list[str],
+                          node_name: str) -> None:
+        """DefaultPreemption side-effects: API-delete victims + record."""
+        self.metrics.preemption_victims.observe(len(victim_keys))
+
+        async def do():
+            from kubernetes_tpu.store.mvcc import StoreError
+            for vk in victim_keys:
+                try:
+                    await self.store.delete("pods", vk)
+                except StoreError:
+                    pass
+
+            def set_nominated(p):
+                p.setdefault("status", {})["nominatedNodeName"] = node_name
+                return p
+            try:
+                await self.store.guaranteed_update("pods", pod.key, set_nominated)
+            except StoreError:
+                pass
+        asyncio.ensure_future(do())
+
+    # ------------------------------------------------------------------
+
+    async def _cache_janitor(self) -> None:
+        """Periodic expiry of assumed-but-never-confirmed pods
+        (cache.run → cleanupAssumedPods every 1s in the reference)."""
+        try:
+            while not self._stop:
+                await asyncio.sleep(5.0)
+                self.cache.cleanup_expired()
+        except asyncio.CancelledError:
+            return
+
+    async def run(self, batch_size: int = 1) -> None:
+        """wait.UntilWithContext(sched.ScheduleOne) — plus flushers."""
+        flusher = asyncio.ensure_future(self.queue.run_flushers())
+        janitor = asyncio.ensure_future(self._cache_janitor())
+        try:
+            while not self._stop:
+                more = await self.schedule_batch(batch_size)
+                if not more:
+                    break
+                self.metrics.set_pending(self.queue.stats())
+        finally:
+            flusher.cancel()
+            janitor.cancel()
+
+    async def stop(self) -> None:
+        self._stop = True
+        await self.queue.close()
+        for t in list(self._binding_tasks):
+            t.cancel()
+        await asyncio.gather(*self._binding_tasks, return_exceptions=True)

@@ -1,0 +1,325 @@
+"""Plugin semantics tests — the CPU oracle's correctness fixture suite.
+
+Mirrors the reference's plugin unit style: build NodeInfo/PodInfo fixtures
+directly, no API server (pkg/scheduler/framework/plugins/*/
+*_test.go table-driven tests)."""
+
+from kubernetes_tpu.api.resource import parse_quantity
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.scheduler.framework import CycleState, Framework
+from kubernetes_tpu.scheduler.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.scheduler.plugins.nodeaffinity import (
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    TaintToleration,
+)
+from kubernetes_tpu.scheduler.plugins.noderesources import (
+    BalancedAllocation,
+    NodeResourcesFit,
+    insufficient_resources,
+)
+from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+
+def ni(name, allocatable=None, labels=None, taints=None, unschedulable=False,
+       pods=()):
+    node = NodeInfo(make_node(name, allocatable=allocatable, labels=labels,
+                              taints=taints, unschedulable=unschedulable))
+    for p in pods:
+        node.add_pod(p)
+    return node
+
+
+def pp(name, **kw):
+    return PodInfo(make_pod(name, **kw))
+
+
+class TestNodeResourcesFit:
+    def test_filter_insufficient_cpu(self):
+        node = ni("n1", allocatable={"cpu": "2", "memory": "4Gi", "pods": "10"})
+        node.add_pod(pp("existing", requests={"cpu": "1500m"}))
+        plug = NodeResourcesFit()
+        st = plug.filter(CycleState(), pp("new", requests={"cpu": "1"}), node)
+        assert not st.is_success()
+        assert "Insufficient cpu" in st.reasons
+
+    def test_filter_max_pods(self):
+        node = ni("n1", allocatable={"cpu": "64", "memory": "256Gi", "pods": "2"})
+        node.add_pod(pp("a"))
+        node.add_pod(pp("b"))
+        st = NodeResourcesFit().filter(CycleState(), pp("c"), node)
+        assert "Too many pods" in st.reasons
+
+    def test_filter_extended_resource(self):
+        node = ni("n1", allocatable={"cpu": "8", "memory": "16Gi",
+                                     "google.com/tpu": "4", "pods": "110"})
+        plug = NodeResourcesFit()
+        ok = plug.filter(CycleState(), pp("a", requests={"google.com/tpu": "4"}), node)
+        assert ok.is_success()
+        node.add_pod(pp("holder", requests={"google.com/tpu": "2"}))
+        bad = plug.filter(CycleState(), pp("b", requests={"google.com/tpu": "3"}), node)
+        assert "Insufficient google.com/tpu" in bad.reasons
+
+    def test_least_allocated_score(self):
+        plug = NodeResourcesFit()
+        empty = ni("empty", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        half = ni("half", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        half.add_pod(pp("filler", requests={"cpu": "5", "memory": "5Gi"}))
+        pod = pp("new", requests={"cpu": "1", "memory": "1Gi"})
+        s_empty = plug.score(CycleState(), pod, empty)
+        s_half = plug.score(CycleState(), pod, half)
+        assert s_empty > s_half  # LeastAllocated prefers the empty node
+        assert abs(s_empty - 90.0) < 1e-6  # (10-1)/10 * 100
+
+    def test_most_allocated_prefers_packed(self):
+        plug = NodeResourcesFit({"scoringStrategy": {"type": "MostAllocated"}})
+        empty = ni("empty", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        half = ni("half", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        half.add_pod(pp("filler", requests={"cpu": "5", "memory": "5Gi"}))
+        pod = pp("new", requests={"cpu": "1", "memory": "1Gi"})
+        assert plug.score(CycleState(), pod, half) > plug.score(CycleState(), pod, empty)
+
+    def test_requested_to_capacity_ratio_shape(self):
+        plug = NodeResourcesFit({"scoringStrategy": {
+            "type": "RequestedToCapacityRatio",
+            "requestedToCapacityRatio": {
+                "shape": [{"utilization": 0, "score": 10},
+                          {"utilization": 100, "score": 0}]},
+        }})
+        empty = ni("e", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        pod = pp("p", requests={"cpu": "5", "memory": "5Gi"})
+        # 50% utilization on both → raw 5 → scaled 50
+        assert abs(plug.score(CycleState(), pod, empty) - 50.0) < 1e-6
+
+    def test_insufficient_reasons_list(self):
+        node = ni("n1", allocatable={"cpu": "1", "memory": "1Gi", "pods": "110"})
+        reasons = insufficient_resources(
+            pp("big", requests={"cpu": "2", "memory": "2Gi"}), node)
+        assert set(reasons) == {"Insufficient cpu", "Insufficient memory"}
+
+
+class TestBalancedAllocation:
+    def test_balanced_beats_skewed(self):
+        plug = BalancedAllocation()
+        balanced = ni("b", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        balanced.add_pod(pp("x", requests={"cpu": "5", "memory": "5Gi"}))
+        skewed = ni("s", allocatable={"cpu": "10", "memory": "10Gi", "pods": "110"})
+        skewed.add_pod(pp("y", requests={"cpu": "9", "memory": "1Gi"}))
+        pod = pp("new", requests={"cpu": "500m", "memory": "512Mi"})
+        assert plug.score(CycleState(), pod, balanced) > plug.score(CycleState(), pod, skewed)
+
+
+class TestNodePredicates:
+    def test_node_name(self):
+        assert NodeName().filter(CycleState(), pp("a", node_name=None), ni("n1")).is_success()
+        pod = PodInfo(make_pod("a", node_name="n2"))
+        assert not NodeName().filter(CycleState(), pod, ni("n1")).is_success()
+
+    def test_node_unschedulable(self):
+        assert not NodeUnschedulable().filter(
+            CycleState(), pp("a"), ni("n1", unschedulable=True)).is_success()
+        tolerant = pp("b", tolerations=[
+            {"key": "node.kubernetes.io/unschedulable", "operator": "Exists"}])
+        assert NodeUnschedulable().filter(
+            CycleState(), tolerant, ni("n1", unschedulable=True)).is_success()
+
+    def test_node_selector(self):
+        node = ni("n1", labels={"disk": "ssd"})
+        ok = pp("a", node_selector={"disk": "ssd"})
+        bad = pp("b", node_selector={"disk": "hdd"})
+        assert NodeAffinity().filter(CycleState(), ok, node).is_success()
+        assert not NodeAffinity().filter(CycleState(), bad, node).is_success()
+
+    def test_required_node_affinity(self):
+        node = ni("n1", labels={"zone": "us-a"})
+        affinity = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["us-a", "us-b"]}]}]}}}
+        assert NodeAffinity().filter(
+            CycleState(), pp("a", affinity=affinity), node).is_success()
+        node2 = ni("n2", labels={"zone": "eu-a"})
+        assert not NodeAffinity().filter(
+            CycleState(), pp("b", affinity=affinity), node2).is_success()
+
+    def test_preferred_node_affinity_score(self):
+        affinity = {"nodeAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 80, "preference": {"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["us-a"]}]}},
+            {"weight": 20, "preference": {"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["ssd"]}]}},
+        ]}}
+        plug = NodeAffinity()
+        pod = pp("a", affinity=affinity)
+        both = ni("n1", labels={"zone": "us-a", "disk": "ssd"})
+        one = ni("n2", labels={"zone": "us-a"})
+        neither = ni("n3", labels={"zone": "eu"})
+        assert plug.score(CycleState(), pod, both) == 100.0
+        assert plug.score(CycleState(), pod, one) == 80.0
+        assert plug.score(CycleState(), pod, neither) == 0.0
+
+    def test_taint_filter_and_score(self):
+        taints = [{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]
+        node = ni("n1", taints=taints)
+        assert not TaintToleration().filter(CycleState(), pp("a"), node).is_success()
+        tolerant = pp("b", tolerations=[{"key": "dedicated", "value": "gpu"}])
+        assert TaintToleration().filter(CycleState(), tolerant, node).is_success()
+
+    def test_taint_prefer_noschedule_normalize(self):
+        plug = TaintToleration()
+        soft = ni("soft", taints=[
+            {"key": "a", "value": "1", "effect": "PreferNoSchedule"}])
+        clean = ni("clean")
+        pod = pp("p")
+        scores = {"soft": plug.score(CycleState(), pod, soft),
+                  "clean": plug.score(CycleState(), pod, clean)}
+        plug.normalize_scores(CycleState(), pod, scores)
+        assert scores["clean"] == 100.0 and scores["soft"] == 0.0
+
+    def test_node_ports_conflict(self):
+        node = ni("n1")
+        node.add_pod(pp("existing", host_ports=[8080]))
+        st = NodePorts().filter(CycleState(), pp("new", host_ports=[8080]), node)
+        assert not st.is_success()
+        assert NodePorts().filter(
+            CycleState(), pp("other", host_ports=[9090]), node).is_success()
+
+
+class TestInterPodAffinity:
+    def _snap(self):
+        web = pp("web-1", labels={"app": "web"})
+        n1 = ni("n1", labels={"zone": "a", "kubernetes.io/hostname": "n1"}, pods=[web])
+        n2 = ni("n2", labels={"zone": "b", "kubernetes.io/hostname": "n2"})
+        return Snapshot([n1, n2])
+
+    def _required_anti(self, topology_key="kubernetes.io/hostname"):
+        return {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": topology_key}]}}
+
+    def test_anti_affinity_blocks_same_host(self):
+        snap = self._snap()
+        plug = InterPodAffinity()
+        pod = pp("web-2", labels={"app": "web"}, affinity=self._required_anti())
+        state = CycleState()
+        assert plug.pre_filter(state, pod, snap).is_success()
+        assert not plug.filter(state, pod, snap.get("n1")).is_success()
+        assert plug.filter(state, pod, snap.get("n2")).is_success()
+
+    def test_anti_affinity_zone_wide(self):
+        snap = self._snap()
+        plug = InterPodAffinity()
+        pod = pp("web-2", labels={"app": "web"},
+                 affinity=self._required_anti("zone"))
+        state = CycleState()
+        plug.pre_filter(state, pod, snap)
+        assert not plug.filter(state, pod, snap.get("n1")).is_success()
+        assert plug.filter(state, pod, snap.get("n2")).is_success()
+
+    def test_affinity_requires_colocation(self):
+        snap = self._snap()
+        plug = InterPodAffinity()
+        affinity = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "web"}},
+             "topologyKey": "zone"}]}}
+        pod = pp("sidecar", labels={"role": "cache"}, affinity=affinity)
+        state = CycleState()
+        plug.pre_filter(state, pod, snap)
+        assert plug.filter(state, pod, snap.get("n1")).is_success()
+        assert not plug.filter(state, pod, snap.get("n2")).is_success()
+
+    def test_first_pod_in_group_rule(self):
+        """A pod whose affinity matches itself can schedule when no pod in the
+        cluster matches (otherwise deployments could never bootstrap)."""
+        empty = Snapshot([ni("n1", labels={"zone": "a"})])
+        plug = InterPodAffinity()
+        affinity = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "db"}}, "topologyKey": "zone"}]}}
+        pod = pp("db-0", labels={"app": "db"}, affinity=affinity)
+        state = CycleState()
+        plug.pre_filter(state, pod, empty)
+        assert plug.filter(state, pod, empty.get("n1")).is_success()
+
+    def test_existing_anti_affinity_symmetry(self):
+        """Existing pod's required anti-affinity keeps matching new pods out."""
+        guard = pp("guard", labels={"app": "solo"},
+                   affinity={"podAntiAffinity": {
+                       "requiredDuringSchedulingIgnoredDuringExecution": [
+                           {"labelSelector": {"matchLabels": {"tier": "batch"}},
+                            "topologyKey": "kubernetes.io/hostname"}]}})
+        n1 = ni("n1", labels={"kubernetes.io/hostname": "n1"}, pods=[guard])
+        n2 = ni("n2", labels={"kubernetes.io/hostname": "n2"})
+        snap = Snapshot([n1, n2])
+        plug = InterPodAffinity()
+        pod = pp("batch-1", labels={"tier": "batch"})
+        state = CycleState()
+        plug.pre_filter(state, pod, snap)
+        assert not plug.filter(state, pod, snap.get("n1")).is_success()
+        assert plug.filter(state, pod, snap.get("n2")).is_success()
+
+    def test_preferred_affinity_scoring(self):
+        snap = self._snap()
+        plug = InterPodAffinity()
+        pod = pp("friend", labels={"role": "cache"}, affinity={
+            "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 100, "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "zone"}}]}})
+        state = CycleState()
+        plug.pre_score(state, pod, snap.nodes)
+        scores = {n.name: plug.score(state, pod, n) for n in snap.nodes}
+        plug.normalize_scores(state, pod, scores)
+        assert scores["n1"] == 100.0 and scores["n2"] == 0.0
+
+
+class TestPodTopologySpread:
+    def test_do_not_schedule_skew(self):
+        sel = {"matchLabels": {"app": "web"}}
+        cons = [{"maxSkew": 1, "topologyKey": "zone",
+                 "whenUnsatisfiable": "DoNotSchedule", "labelSelector": sel}]
+        w = lambda i: pp(f"w{i}", labels={"app": "web"})
+        n1 = ni("n1", labels={"zone": "a"}, pods=[w(1), w(2)])
+        n2 = ni("n2", labels={"zone": "b"}, pods=[w(3)])
+        n3 = ni("n3", labels={"zone": "c"})
+        snap = Snapshot([n1, n2, n3])
+        plug = PodTopologySpread()
+        pod = pp("w4", labels={"app": "web"}, topology_spread_constraints=cons)
+        state = CycleState()
+        assert plug.pre_filter(state, pod, snap).is_success()
+        # zone a has 2, min is 0 (zone c) → adding to a gives skew 3 > 1
+        assert not plug.filter(state, pod, n1).is_success()
+        # zone b: 1+1-0 = 2 > 1 → also blocked
+        assert not plug.filter(state, pod, n2).is_success()
+        # zone c: 0+1-0 = 1 ≤ 1 → allowed
+        assert plug.filter(state, pod, n3).is_success()
+
+    def test_missing_topology_key_unresolvable(self):
+        cons = [{"maxSkew": 1, "topologyKey": "zone",
+                 "whenUnsatisfiable": "DoNotSchedule",
+                 "labelSelector": {"matchLabels": {"app": "web"}}}]
+        nolabel = ni("bare")
+        snap = Snapshot([nolabel])
+        plug = PodTopologySpread()
+        pod = pp("w", labels={"app": "web"}, topology_spread_constraints=cons)
+        state = CycleState()
+        plug.pre_filter(state, pod, snap)
+        st = plug.filter(state, pod, nolabel)
+        assert not st.is_success()
+
+    def test_schedule_anyway_scores_spread(self):
+        sel = {"matchLabels": {"app": "web"}}
+        cons = [{"maxSkew": 1, "topologyKey": "zone",
+                 "whenUnsatisfiable": "ScheduleAnyway", "labelSelector": sel}]
+        w = lambda i: pp(f"w{i}", labels={"app": "web"})
+        n1 = ni("n1", labels={"zone": "a"}, pods=[w(1), w(2), w(3)])
+        n2 = ni("n2", labels={"zone": "b"})
+        plug = PodTopologySpread()
+        pod = pp("w4", labels={"app": "web"}, topology_spread_constraints=cons)
+        state = CycleState()
+        plug.pre_score(state, pod, [n1, n2])
+        scores = {n.name: plug.score(state, pod, n) for n in (n1, n2)}
+        plug.normalize_scores(state, pod, scores)
+        assert scores["n2"] > scores["n1"]

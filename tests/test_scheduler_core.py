@@ -1,0 +1,257 @@
+"""Scheduler core tests: cache assume/expire, queue tiers, framework points."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.scheduler import (
+    Framework,
+    PodInfo,
+    SchedulerCache,
+    SchedulingQueue,
+    Status,
+)
+from kubernetes_tpu.scheduler.framework import CycleState
+from kubernetes_tpu.scheduler.plugins.core import PrioritySort, SchedulingGates
+from kubernetes_tpu.scheduler.queue import ClusterEvent, QUEUE, QUEUE_SKIP
+from kubernetes_tpu.scheduler.types import Snapshot
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def pi(name, priority=0, node=None, requests=None, gates=None):
+    return PodInfo(make_pod(name, priority=priority, node_name=node,
+                            requests=requests, scheduling_gates=gates))
+
+
+class TestCache:
+    def test_assume_confirm_lifecycle(self):
+        c = SchedulerCache()
+        c.add_node(make_node("n1", allocatable={"cpu": "4", "memory": "8Gi", "pods": "10"}))
+        p = pi("a", requests={"cpu": "1"})
+        c.assume_pod(p, "n1")
+        assert c.is_assumed("default/a")
+        snap = c.update_snapshot()
+        assert snap.get("n1").requested.get("cpu") == 1000
+
+        # informer confirms
+        bound = PodInfo(make_pod("a", requests={"cpu": "1"}, node_name="n1"))
+        c.add_pod(bound)
+        assert not c.is_assumed("default/a")
+        assert c.update_snapshot().get("n1").requested.get("cpu") == 1000
+
+    def test_assume_expire(self):
+        c = SchedulerCache(assumed_pod_ttl=10)
+        c.add_node(make_node("n1"))
+        p = pi("a", requests={"cpu": "1"})
+        c.assume_pod(p, "n1")
+        c.finish_binding("default/a", now=100.0)
+        assert c.cleanup_expired(now=105.0) == []
+        assert c.cleanup_expired(now=111.0) == ["default/a"]
+        assert c.update_snapshot().get("n1").requested.get("cpu") == 0
+
+    def test_forget_restores_resources(self):
+        c = SchedulerCache()
+        c.add_node(make_node("n1"))
+        p = pi("a", requests={"cpu": "2"})
+        c.assume_pod(p, "n1")
+        c.forget_pod("default/a")
+        snap = c.update_snapshot()
+        assert snap.get("n1").requested.get("cpu") == 0
+        assert snap.get("n1").requested.pods == 0
+
+    def test_incremental_snapshot_reuses_unchanged_nodes(self):
+        c = SchedulerCache()
+        for i in range(4):
+            c.add_node(make_node(f"n{i}"))
+        s1 = c.update_snapshot()
+        c.assume_pod(pi("a", requests={"cpu": "1"}), "n2")
+        s2 = c.update_snapshot()
+        # unchanged nodes are the same cloned object; changed node re-cloned
+        assert s1.get("n0") is s2.get("n0")
+        assert s1.get("n2") is not s2.get("n2")
+
+    def test_double_assume_raises(self):
+        c = SchedulerCache()
+        c.add_node(make_node("n1"))
+        p = pi("a")
+        c.assume_pod(p, "n1")
+        with pytest.raises(ValueError):
+            c.assume_pod(pi("a"), "n1")
+
+
+class TestQueue:
+    def _mk(self, **kw):
+        fwk = Framework([PrioritySort(), SchedulingGates()])
+        return SchedulingQueue(fwk, **kw)
+
+    def test_priority_order(self):
+        async def body():
+            q = self._mk()
+            await q.add(pi("low", priority=1))
+            await q.add(pi("high", priority=100))
+            await q.add(pi("mid", priority=50))
+            got = [p.name for p in await q.pop_batch(3)]
+            assert got == ["high", "mid", "low"]
+        run(body())
+
+    def test_gated_pods_stay_out(self):
+        async def body():
+            q = self._mk()
+            await q.add(pi("gated", gates=["wait-for-quota"]))
+            await q.add(pi("free"))
+            got = await q.pop_batch(5)
+            assert [p.name for p in got] == ["free"]
+            assert q.stats()["gated"] == 1
+            # gate removal → update re-evaluates PreEnqueue
+            await q.update(pi("gated"))
+            got = await q.pop_batch(5)
+            assert [p.name for p in got] == ["gated"]
+        run(body())
+
+    def test_unschedulable_event_move(self):
+        async def body():
+            clock = [0.0]
+            q = self._mk(clock=lambda: clock[0], initial_backoff=0.0)
+            p = pi("a")
+            await q.add(p)
+            (popped,) = await q.pop_batch(1)
+            popped.unschedulable_plugins = {"NodeResourcesFit"}
+            await q.add_unschedulable(popped)
+            assert q.stats()["unschedulable"] == 1
+            q.register_hint("Node/Add", "NodeResourcesFit", lambda pi, ev: QUEUE)
+            moved = await q.move_all(ClusterEvent("Node", "Add"))
+            assert moved == 1
+            got = await q.pop_batch(1)
+            assert got[0].name == "a"
+        run(body())
+
+    def test_hint_skip_keeps_parked(self):
+        async def body():
+            q = self._mk()
+            p = pi("a")
+            await q.add(p)
+            (popped,) = await q.pop_batch(1)
+            popped.unschedulable_plugins = {"NodeResourcesFit"}
+            await q.add_unschedulable(popped)
+            q.register_hint("Node/Add", "NodeResourcesFit",
+                            lambda pi, ev: QUEUE_SKIP)
+            moved = await q.move_all(ClusterEvent("Node", "Add"))
+            assert moved == 0
+            assert q.stats()["unschedulable"] == 1
+        run(body())
+
+    def test_backoff_flush_by_clock(self):
+        async def body():
+            clock = [100.0]
+            q = self._mk(clock=lambda: clock[0], initial_backoff=2.0)
+            p = pi("a")
+            await q.add(p)
+            (popped,) = await q.pop_batch(1)
+            await q.move_to_backoff(popped)
+            assert q.stats()["backoff"] == 1
+            clock[0] = 103.0  # past 2s backoff
+            got = await asyncio.wait_for(q.pop_batch(1), 2)
+            assert got[0].name == "a"
+        run(body())
+
+    def test_leftover_flush(self):
+        async def body():
+            clock = [0.0]
+            q = self._mk(clock=lambda: clock[0], initial_backoff=0.0,
+                         unschedulable_flush_interval=60.0)
+            p = pi("a")
+            await q.add(p)
+            (popped,) = await q.pop_batch(1)
+            await q.add_unschedulable(popped)
+            clock[0] = 30.0
+            assert await q.flush_unschedulable_leftover() == 0
+            clock[0] = 61.0
+            assert await q.flush_unschedulable_leftover() == 1
+        run(body())
+
+    def test_event_during_in_flight_cycle_goes_to_backoff(self):
+        """moveRequestCycle semantics: a pod that fails while a cluster event
+        fired mid-cycle must land in backoff (prompt retry), not the
+        unschedulable pool (60s stall)."""
+        async def body():
+            clock = [0.0]
+            q = self._mk(clock=lambda: clock[0], initial_backoff=1.0)
+            await q.add(pi("a"))
+            (popped,) = await q.pop_batch(1)  # cycle in flight
+            await q.move_all(ClusterEvent("Node", "Add"))  # event mid-cycle
+            await q.add_unschedulable(popped)  # cycle fails afterwards
+            stats = q.stats()
+            assert stats["backoff"] == 1 and stats["unschedulable"] == 0
+        run(body())
+
+    def test_batch_pop(self):
+        async def body():
+            q = self._mk()
+            for i in range(10):
+                await q.add(pi(f"p{i}", priority=i))
+            batch = await q.pop_batch(4)
+            assert [p.name for p in batch] == ["p9", "p8", "p7", "p6"]
+            assert q.stats()["active"] == 6
+        run(body())
+
+
+class _AlwaysFilter:
+    pass
+
+
+class TestFramework:
+    def test_prefilter_skip_suppresses_filter(self):
+        from kubernetes_tpu.scheduler.plugins.nodeaffinity import NodeAffinity
+        fwk = Framework([NodeAffinity()])
+        state = CycleState()
+        pod = pi("plain")  # no affinity → PreFilter returns Skip
+        snap = Snapshot([])
+        assert fwk.run_pre_filter(state, pod, snap).is_success()
+        assert "NodeAffinity" in state.skip_filter_plugins
+
+    def test_reserve_failure_unwinds(self):
+        from kubernetes_tpu.scheduler import Plugin
+
+        events = []
+
+        class R1(Plugin):
+            NAME = "R1"
+            EXTENSION_POINTS = ("Reserve",)
+
+            def reserve(self, state, pod, node):
+                events.append("r1-reserve")
+                return Status.success()
+
+            def unreserve(self, state, pod, node):
+                events.append("r1-unreserve")
+
+        class R2(Plugin):
+            NAME = "R2"
+            EXTENSION_POINTS = ("Reserve",)
+
+            def reserve(self, state, pod, node):
+                events.append("r2-reserve")
+                return Status.unschedulable("nope")
+
+        fwk = Framework([R1(), R2()])
+        st = fwk.run_reserve(CycleState(), pi("a"), "n1")
+        assert not st.is_success()
+        assert events == ["r1-reserve", "r2-reserve", "r1-unreserve"]
+
+    def test_permit_wait_aggregation(self):
+        from kubernetes_tpu.scheduler import Plugin
+
+        class W(Plugin):
+            NAME = "W"
+            EXTENSION_POINTS = ("Permit",)
+
+            def permit(self, state, pod, node):
+                return Status.wait(), 5.0
+
+        fwk = Framework([W()])
+        st, timeout = fwk.run_permit(CycleState(), pi("a"), "n1")
+        assert st.is_wait() and timeout == 5.0

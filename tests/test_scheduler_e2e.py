@@ -1,0 +1,167 @@
+"""End-to-end scheduler tests: store → informers → cycles → Binding in store.
+
+The scheduler_perf trick (SURVEY §3.5): pods "run" because nothing contradicts
+Bind — no kubelet needed.
+"""
+
+import asyncio
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_cluster(num_nodes=5, node_kw=None):
+    store = new_cluster_store()
+    install_core_validation(store)
+    for i in range(num_nodes):
+        await store.create("nodes", make_node(f"node-{i}", **(node_kw or {})))
+    return store
+
+
+async def start_scheduler(store, **kw):
+    sched = Scheduler(store, seed=42, **kw)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    return sched, factory
+
+
+async def wait_bound(store, n, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        pods = (await store.list("pods")).items
+        bound = [p for p in pods if p["spec"].get("nodeName")]
+        if len(bound) >= n:
+            return bound
+        await asyncio.sleep(0.05)
+    return [p for p in (await store.list("pods")).items if p["spec"].get("nodeName")]
+
+
+class TestE2E:
+    def test_schedules_pending_pods(self):
+        async def body():
+            store = await make_cluster(5)
+            sched, factory = await start_scheduler(store)
+            for i in range(20):
+                await store.create("pods", make_pod(
+                    f"p{i}", requests={"cpu": "100m", "memory": "128Mi"}))
+            loop = asyncio.ensure_future(sched.run())
+            bound = await wait_bound(store, 20)
+            assert len(bound) == 20
+            # spread across nodes (LeastAllocated should balance)
+            nodes_used = {p["spec"]["nodeName"] for p in bound}
+            assert len(nodes_used) == 5
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_unschedulable_then_node_added(self):
+        async def body():
+            store = await make_cluster(1, node_kw={
+                "allocatable": {"cpu": "1", "memory": "1Gi", "pods": "110"}})
+            sched, factory = await start_scheduler(store)
+            await store.create("pods", make_pod("big", requests={"cpu": "4"}))
+            loop = asyncio.ensure_future(sched.run())
+            await asyncio.sleep(0.3)
+            assert sched.queue.stats()["unschedulable"] == 1
+            events = (await store.list("events")).items
+            assert any(e.get("reason") == "FailedScheduling" for e in events)
+            # Node/Add event moves the pod back; it then schedules.
+            await store.create("nodes", make_node(
+                "bignode", allocatable={"cpu": "8", "memory": "8Gi", "pods": "110"}))
+            bound = await wait_bound(store, 1, timeout=8)
+            assert len(bound) == 1 and bound[0]["spec"]["nodeName"] == "bignode"
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_batched_pop_resolves_contention(self):
+        """With batch>1 and the host fallback path, pods later in the batch
+        see earlier assumes (no double-booking the same free slot)."""
+        async def body():
+            store = await make_cluster(2, node_kw={
+                "allocatable": {"cpu": "2", "memory": "4Gi", "pods": "110"}})
+            sched, factory = await start_scheduler(store)
+            for i in range(4):
+                await store.create("pods", make_pod(
+                    f"p{i}", requests={"cpu": "1"}))
+            loop = asyncio.ensure_future(sched.run(batch_size=4))
+            bound = await wait_bound(store, 4)
+            assert len(bound) == 4
+            per_node = {}
+            for p in bound:
+                per_node.setdefault(p["spec"]["nodeName"], 0)
+                per_node[p["spec"]["nodeName"]] += 1
+            assert all(v == 2 for v in per_node.values()), per_node
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_preemption_evicts_lower_priority(self):
+        async def body():
+            store = await make_cluster(1, node_kw={
+                "allocatable": {"cpu": "2", "memory": "4Gi", "pods": "110"}})
+            sched, factory = await start_scheduler(store)
+            loop = asyncio.ensure_future(sched.run())
+            await store.create("pods", make_pod(
+                "victim", requests={"cpu": "2"}, priority=0))
+            await wait_bound(store, 1)
+            await store.create("pods", make_pod(
+                "preemptor", requests={"cpu": "2"}, priority=1000))
+            # victim gets API-deleted; preemptor eventually binds
+            for _ in range(100):
+                pods = {p["metadata"]["name"]: p
+                        for p in (await store.list("pods")).items}
+                if ("victim" not in pods
+                        and pods.get("preemptor", {}).get("spec", {}).get("nodeName")):
+                    break
+                await asyncio.sleep(0.05)
+            pods = {p["metadata"]["name"]: p
+                    for p in (await store.list("pods")).items}
+            assert "victim" not in pods
+            assert pods["preemptor"]["spec"].get("nodeName") == "node-0"
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_affinity_e2e(self):
+        async def body():
+            store = await make_cluster(0)
+            for zone, name in (("a", "za-1"), ("a", "za-2"), ("b", "zb-1")):
+                await store.create("nodes", make_node(
+                    name, labels={"topology.kubernetes.io/zone": zone}))
+            sched, factory = await start_scheduler(store)
+            loop = asyncio.ensure_future(sched.run())
+            await store.create("pods", make_pod(
+                "db", labels={"app": "db"},
+                node_selector={"topology.kubernetes.io/zone": "a"}))
+            await wait_bound(store, 1)
+            anti = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "db"}},
+                     "topologyKey": "topology.kubernetes.io/zone"}]}}
+            await store.create("pods", make_pod(
+                "db2", labels={"app": "db"}, affinity=anti))
+            bound = await wait_bound(store, 2)
+            by_name = {p["metadata"]["name"]: p["spec"]["nodeName"] for p in bound}
+            assert by_name["db"].startswith("za")
+            assert by_name["db2"] == "zb-1"  # anti-affinity forced zone b
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
