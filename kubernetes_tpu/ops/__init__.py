@@ -1,0 +1,9 @@
+"""TPU compute path: tensorization, batched kernels, assignment solver.
+
+The device-side replacement for the reference's goroutine fan-out
+(pkg/scheduler/framework/parallelize) — see ops/backend.py for the map.
+"""
+
+from kubernetes_tpu.ops.backend import TPUBackend
+
+__all__ = ["TPUBackend"]

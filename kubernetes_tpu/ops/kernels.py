@@ -1,0 +1,136 @@
+"""Batched (P pods × N nodes) filter-mask and score kernels.
+
+Each kernel is the tensorized twin of one in-tree plugin's Filter/Score
+(SURVEY §2.3 table: NodeResourcesFit / NodeResourcesBalancedAllocation /
+TaintToleration are the north-star tensorization set). The host plugins in
+scheduler/plugins/ stay the correctness oracle; tests/test_tpu_backend.py
+differential-tests every kernel against them on randomized clusters.
+
+All kernels are pure jnp functions over fixed-shape arrays (no Python control
+flow on data), composed and jitted once per shape signature by the backend.
+Scores follow the reference's two-phase shape: raw score then per-pod
+NormalizeScore over the *feasible* set only, then plugin weight — weights are
+applied by the backend when summing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100.0
+
+
+# --- NodeResourcesFit: Filter ------------------------------------------------
+
+def fit_filter_mask(alloc_q, used_q, used_pods, alloc_pods, req_q):
+    """(noderesources/fit.go `fitsRequest`) feasibility of every (pod, node):
+    per-resource `used + req <= alloc` AND pod-count headroom.
+
+    alloc_q/used_q: (N,R) int32; used_pods/alloc_pods: (N,) int32;
+    req_q: (P,R) int32 → (P,N) bool.
+    """
+    res_ok = jnp.all(
+        used_q[None, :, :] + req_q[:, None, :] <= alloc_q[None, :, :], axis=-1)
+    pods_ok = (used_pods + 1 <= alloc_pods)[None, :]
+    return res_ok & pods_ok
+
+
+# --- TaintToleration: Filter -------------------------------------------------
+
+def taint_filter_mask(node_taints, untolerated):
+    """(tainttoleration `Filter`) node is infeasible iff it carries any
+    NoSchedule/NoExecute taint the pod does not tolerate.
+
+    node_taints: (N,T) bool membership; untolerated: (P,T) bool → (P,N) bool.
+    """
+    conflicts = jnp.einsum("pt,nt->pn", untolerated.astype(jnp.int32),
+                           node_taints.astype(jnp.int32))
+    return conflicts == 0
+
+
+# --- NodeResourcesFit: Score -------------------------------------------------
+
+def fit_score(alloc_q, used_nz_q, req_nz_q, col_weights, strategy: str,
+              shape_u=None, shape_s=None):
+    """(resource_allocation.go score loop) weighted mean over scoring
+    resources of the per-resource strategy score; columns with zero
+    allocatable are excluded from the mean (host `_score_one` skip).
+
+    alloc_q/used_nz_q: (N,R); req_nz_q: (P,R); col_weights: (R,) float32 with
+    0 for non-scored columns → (P,N) float32 in [0, 100].
+    """
+    alloc = alloc_q.astype(jnp.float32)[None, :, :]           # (1,N,R)
+    req = (used_nz_q[None, :, :] + req_nz_q[:, None, :]).astype(jnp.float32)
+    valid = (alloc > 0) & (col_weights[None, None, :] > 0)
+    safe_alloc = jnp.where(alloc > 0, alloc, 1.0)
+    if strategy == "MostAllocated":
+        s = MAX_NODE_SCORE * req / safe_alloc
+        s = jnp.where(req > alloc, 0.0, s)
+    elif strategy == "RequestedToCapacityRatio":
+        util = MAX_NODE_SCORE * req / safe_alloc
+        s = _piecewise(util, shape_u, shape_s) * (MAX_NODE_SCORE / 10.0)
+        s = jnp.where(req > alloc, 0.0, s)
+    else:  # LeastAllocated
+        s = MAX_NODE_SCORE * (alloc - req) / safe_alloc
+        s = jnp.where(req > alloc, 0.0, s)
+    w = jnp.where(valid, col_weights[None, None, :], 0.0)
+    tot_w = jnp.sum(w, axis=-1)
+    acc = jnp.sum(s * w, axis=-1)
+    return jnp.where(tot_w > 0, acc / jnp.where(tot_w > 0, tot_w, 1.0), 0.0)
+
+
+def _piecewise(util, shape_u, shape_s):
+    """Piecewise-linear shape evaluation (requested_to_capacity_ratio.go);
+    shape_u/shape_s are small 1-D point arrays, util broadcasts over them."""
+    u = util[..., None]                                      # (...,1)
+    below = u <= shape_u[0]
+    above = u >= shape_u[-1]
+    # Segment interpolation: for each interval i, value if u lands in it.
+    u0, u1 = shape_u[:-1], shape_u[1:]
+    s0, s1 = shape_s[:-1], shape_s[1:]
+    t = (u - u0) / jnp.where(u1 - u0 > 0, u1 - u0, 1.0)
+    seg_val = s0 + (s1 - s0) * t
+    in_seg = (u > u0) & (u <= u1)
+    mid = jnp.sum(jnp.where(in_seg, seg_val, 0.0), axis=-1)
+    return jnp.where(below[..., 0], shape_s[0],
+                     jnp.where(above[..., 0], shape_s[-1], mid))
+
+
+# --- NodeResourcesBalancedAllocation: Score ---------------------------------
+
+def balanced_allocation_score(alloc_q, used_nz_q, req_nz_q, col_mask):
+    """(balanced_allocation.go) 100 × (1 − stddev of per-resource requested
+    fractions); fractions clamped to 1; nodes with <2 scorable resources → 0.
+
+    col_mask: (R,) bool — which columns the plugin scores over.
+    """
+    alloc = alloc_q.astype(jnp.float32)[None, :, :]
+    req = (used_nz_q[None, :, :] + req_nz_q[:, None, :]).astype(jnp.float32)
+    valid = (alloc > 0) & col_mask[None, None, :]
+    frac = jnp.minimum(req / jnp.where(alloc > 0, alloc, 1.0), 1.0)
+    frac = jnp.where(valid, frac, 0.0)
+    cnt = jnp.sum(valid, axis=-1).astype(jnp.float32)
+    safe_cnt = jnp.where(cnt > 0, cnt, 1.0)
+    mean = jnp.sum(frac, axis=-1) / safe_cnt
+    var = jnp.sum(jnp.where(valid, (frac - mean[..., None]) ** 2, 0.0),
+                  axis=-1) / safe_cnt
+    score = (1.0 - jnp.sqrt(var)) * MAX_NODE_SCORE
+    return jnp.where(cnt >= 2, score, 0.0)
+
+
+# --- TaintToleration: Score --------------------------------------------------
+
+def taint_toleration_score(node_prefer_taints, untol_prefer, feasible):
+    """(taint_toleration.go Score+NormalizeScore) raw = count of untolerated
+    PreferNoSchedule taints; normalized per pod over feasible nodes to
+    100×(max−count)/max (all-100 when max is 0).
+
+    node_prefer_taints: (N,Tp) bool; untol_prefer: (P,Tp) bool;
+    feasible: (P,N) bool → (P,N) float32.
+    """
+    counts = jnp.einsum("pt,nt->pn", untol_prefer.astype(jnp.float32),
+                        node_prefer_taints.astype(jnp.float32))
+    mx = jnp.max(jnp.where(feasible, counts, -jnp.inf), axis=1, keepdims=True)
+    mx = jnp.maximum(mx, 0.0)
+    return jnp.where(mx > 0, MAX_NODE_SCORE * (mx - counts) / mx,
+                     MAX_NODE_SCORE)

@@ -1,0 +1,252 @@
+"""Snapshot → dense device tensors (the scheduler's "input pipeline").
+
+This is the tensorization point called out in SURVEY §3.1 at
+`cache.UpdateSnapshot` (pkg/scheduler/internal/cache/cache.go): the host-side
+`Snapshot` of `NodeInfo` records is compiled into flat arrays the batched
+filter/score kernels (ops/kernels.py) and the assignment solver (ops/solver.py)
+consume.
+
+Quantization design (sound-by-construction feasibility):
+
+Resource quantities are tracked host-side in integer milli-units
+(pkg/api/resource Quantity semantics). Memory in milli-bytes overflows the
+float32 mantissa (256Gi ≈ 2.7e14), so device arrays use **per-resource
+power-of-two quantization into int32**:
+
+    scale_r  = 2^k, minimal k with  max_allocatable_r / 2^k < 2^20
+    alloc_q  = floor(allocatable / scale)     (node capacity rounded DOWN)
+    used_q   = ceil(requested   / scale)      (resident usage rounded UP)
+    podreq_q = ceil(pod request / scale)      (incoming request rounded UP)
+
+The rounding directions make the device-side fit predicate
+`used_q + podreq_q <= alloc_q` *conservative*: it can never admit a placement
+the exact host predicate (plugins/noderesources.insufficient_resources) would
+reject, at the cost of rejecting placements within one quantum
+(≈ allocatable × 2^-20) of full — negligible, and differential-tested.
+
+Node counts (max-pods) are small ints and carried exactly.
+
+Shapes are padded (nodes to a multiple of `NODE_PAD`, pods to the batch size)
+so XLA compiles one program per (P, N_padded, R) signature instead of one per
+cycle — no data-dependent shapes inside jit (SURVEY §5.7 / XLA semantics).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from kubernetes_tpu.api.types import (
+    CPU,
+    MEMORY,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    TAINT_PREFER_NO_SCHEDULE,
+    toleration_tolerates_taint,
+)
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+#: Node axis is padded to a multiple of this so node add/remove churn doesn't
+#: recompile the kernels every time (and tiles map cleanly onto the VPU/MXU).
+NODE_PAD = 256
+
+#: Quantized allocatable targets < 2^20 quanta → ~1e-6 relative precision.
+_QUANT_BITS = 20
+
+
+def _scale_for(max_value: int) -> int:
+    """Smallest power-of-two scale with max_value/scale < 2^_QUANT_BITS."""
+    if max_value < (1 << _QUANT_BITS):
+        return 1
+    return 1 << (max(0, max_value.bit_length() - _QUANT_BITS))
+
+
+def _quant_floor(v: int, scale: int) -> int:
+    return v // scale
+
+
+def _quant_ceil(v: int, scale: int) -> int:
+    return -((-v) // scale)
+
+
+class TaintTable:
+    """Interned (key, value, effect) taint triples split by filtering effect.
+
+    TaintToleration's Filter only looks at NoSchedule/NoExecute; its Score
+    counts untolerated PreferNoSchedule taints
+    (plugins/tainttoleration — see scheduler/plugins/nodeaffinity.py).
+    Node membership becomes two dense bool matrices; each pod's toleration
+    list compiles to an "untolerated" bool vector host-side (tiny: pods come
+    from templates, so vectors are cached by toleration signature upstream).
+    """
+
+    def __init__(self, nodes: Sequence[NodeInfo]):
+        filt: dict[tuple, int] = {}
+        pref: dict[tuple, int] = {}
+        for ni in nodes:
+            for t in ni.taints:
+                trip = (t.get("key", ""), t.get("value", ""), t.get("effect", ""))
+                if trip[2] in (TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE):
+                    filt.setdefault(trip, len(filt))
+                elif trip[2] == TAINT_PREFER_NO_SCHEDULE:
+                    pref.setdefault(trip, len(pref))
+        self.filter_taints = [dict(key=k, value=v, effect=e) for (k, v, e) in filt]
+        self.prefer_taints = [dict(key=k, value=v, effect=e) for (k, v, e) in pref]
+        self._filt_idx = filt
+        self._pref_idx = pref
+
+    def node_rows(self, nodes: Sequence[NodeInfo], n_pad: int):
+        nf, npf = max(1, len(self.filter_taints)), max(1, len(self.prefer_taints))
+        filt = np.zeros((n_pad, nf), dtype=np.bool_)
+        pref = np.zeros((n_pad, npf), dtype=np.bool_)
+        for i, ni in enumerate(nodes):
+            for t in ni.taints:
+                trip = (t.get("key", ""), t.get("value", ""), t.get("effect", ""))
+                j = self._filt_idx.get(trip)
+                if j is not None:
+                    filt[i, j] = True
+                j = self._pref_idx.get(trip)
+                if j is not None:
+                    pref[i, j] = True
+        return filt, pref
+
+    def untolerated(self, tolerations: list, which: str) -> np.ndarray:
+        """Bool vector over the interned taints this pod does NOT tolerate."""
+        taints = self.filter_taints if which == "filter" else self.prefer_taints
+        out = np.zeros((max(1, len(taints)),), dtype=np.bool_)
+        for j, taint in enumerate(taints):
+            if not any(toleration_tolerates_taint(t, taint) for t in tolerations):
+                out[j] = True
+        return out
+
+
+class ClusterTensors:
+    """Dense, device-ready view of one Snapshot.
+
+    Rebuilt when the snapshot generation moves; the expensive static pieces
+    (taint interning) are reused while the node set + taints are unchanged.
+    """
+
+    def __init__(self, snapshot: Snapshot, resources: Sequence[str] | None = None,
+                 prev: "ClusterTensors | None" = None):
+        nodes = snapshot.nodes
+        self.generation = snapshot.generation
+        self.node_names = [ni.name for ni in nodes]
+        self.name_to_idx = {n: i for i, n in enumerate(self.node_names)}
+        self.n_real = len(nodes)
+        self.n_pad = max(NODE_PAD, math.ceil(max(1, self.n_real) / NODE_PAD) * NODE_PAD)
+
+        # Resource columns: union of any caller-pinned prefix (stable jit
+        # signature ordering) with every resource allocatable on any node —
+        # pinning is a minimum set, never exclusive, so a pod requesting a
+        # node-present resource is always tracked. A resource absent from
+        # *all* nodes stays untracked: the host path would reject such a pod
+        # on every node anyway ("Insufficient <r>"), which is exactly what
+        # the backend reports for it.
+        seen = {r: None for r in (resources or ())}
+        seen.setdefault(CPU, None)
+        seen.setdefault(MEMORY, None)
+        for ni in nodes:
+            for r in ni.allocatable.res:
+                seen.setdefault(r, None)
+        self.resources = list(seen)
+        self.r_index = {r: j for j, r in enumerate(self.resources)}
+        R = len(self.resources)
+
+        # Per-resource power-of-two scales (see module docstring).
+        max_alloc = [1] * R
+        for ni in nodes:
+            for j, r in enumerate(self.resources):
+                a = ni.allocatable.get(r)
+                if a > max_alloc[j]:
+                    max_alloc[j] = a
+        self.scales = [_scale_for(m) for m in max_alloc]
+
+        N, sc = self.n_pad, self.scales
+        self.alloc_q = np.zeros((N, R), dtype=np.int32)
+        self.used_q = np.zeros((N, R), dtype=np.int32)
+        self.used_nz_q = np.zeros((N, R), dtype=np.int32)  # nonzero-defaults view (Score)
+        self.alloc_pods = np.zeros((N,), dtype=np.int32)
+        self.used_pods = np.zeros((N,), dtype=np.int32)
+        for i, ni in enumerate(nodes):
+            for j, r in enumerate(self.resources):
+                self.alloc_q[i, j] = _quant_floor(ni.allocatable.get(r), sc[j])
+                self.used_q[i, j] = _quant_ceil(ni.requested.get(r), sc[j])
+                self.used_nz_q[i, j] = _quant_ceil(ni.nonzero_requested.get(r), sc[j])
+            self.alloc_pods[i] = ni.allocatable.pods
+            self.used_pods[i] = ni.requested.pods
+
+        # Padding rows have zero capacity → never feasible; also carry an
+        # explicit validity mask for score normalization.
+        self.valid = np.zeros((N,), dtype=np.bool_)
+        self.valid[: self.n_real] = True
+
+        # Taints: reuse the interning when the static fingerprint matches.
+        fp = tuple((ni.name, id(ni.node)) for ni in nodes)
+        if prev is not None and prev._static_fp == fp and prev.n_pad == N:
+            self.taints = prev.taints
+            self.taint_filter_mat = prev.taint_filter_mat
+            self.taint_prefer_mat = prev.taint_prefer_mat
+        else:
+            self.taints = TaintTable(nodes)
+            self.taint_filter_mat, self.taint_prefer_mat = \
+                self.taints.node_rows(nodes, N)
+        self._static_fp = fp
+
+    # -- per-pod compilation -------------------------------------------------
+
+    def quantize_requests(self, requests: Mapping[str, int],
+                          nonzero: Mapping[str, int]) -> tuple[np.ndarray, np.ndarray]:
+        R = len(self.resources)
+        q = np.zeros((R,), dtype=np.int32)
+        qnz = np.zeros((R,), dtype=np.int32)
+        for r, v in requests.items():
+            j = self.r_index.get(r)
+            if j is not None:
+                q[j] = _quant_ceil(v, self.scales[j])
+        for r, v in nonzero.items():
+            j = self.r_index.get(r)
+            if j is not None:
+                qnz[j] = _quant_ceil(v, self.scales[j])
+        return q, qnz
+
+    def has_unknown_resource(self, requests: Mapping[str, int]) -> bool:
+        """A pod requesting a resource no column tracks. Columns cover every
+        resource allocatable on any node, so this means the resource exists
+        nowhere in the cluster — infeasible on every node, same verdict the
+        host path reaches ("Insufficient <r>"). The backend masks the pod
+        out rather than silently dropping the constraint."""
+        return any(r not in self.r_index for r, v in requests.items() if v)
+
+
+class PodBatch:
+    """Device-ready view of one batch of pending pods (padded to `p_pad`)."""
+
+    def __init__(self, pods: Sequence[PodInfo], ct: ClusterTensors, p_pad: int):
+        self.pods = list(pods)
+        P = p_pad
+        R = len(ct.resources)
+        self.req_q = np.zeros((P, R), dtype=np.int32)
+        self.req_nz_q = np.zeros((P, R), dtype=np.int32)
+        tf = ct.taint_filter_mat.shape[1]
+        tp = ct.taint_prefer_mat.shape[1]
+        self.untol_filter = np.zeros((P, tf), dtype=np.bool_)
+        self.untol_prefer = np.zeros((P, tp), dtype=np.bool_)
+        # Toleration vectors cached by signature: workload pods come from
+        # templates, so distinct toleration lists are few.
+        tol_cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for i, pi in enumerate(pods):
+            self.req_q[i], self.req_nz_q[i] = ct.quantize_requests(
+                pi.requests, pi.nonzero_requests)
+            sig = repr(pi.tolerations)
+            cached = tol_cache.get(sig)
+            if cached is None:
+                cached = (ct.taints.untolerated(pi.tolerations, "filter"),
+                          ct.taints.untolerated(pi.tolerations, "prefer"))
+                tol_cache[sig] = cached
+            self.untol_filter[i], self.untol_prefer[i] = cached
+        # Padding pods: no requests, all-false masks are applied by the
+        # backend (their base mask row is zero), so they never get assigned.
+        self.p_real = len(pods)

@@ -305,9 +305,14 @@ class Scheduler:
             by_profile: dict[str, list[PodInfo]] = {}
             for pi in pods:
                 by_profile.setdefault(pi.scheduler_name, []).append(pi)
+            # Chunk to the backend's batch capacity (its jit signature is
+            # fixed at max_batch); re-snapshot between chunks so later
+            # chunks see earlier chunks' assumes.
+            maxb = getattr(self.backend, "max_batch", None) or len(pods)
             for group in by_profile.values():
-                await self._schedule_via_backend(group, snapshot)
-                snapshot = self.cache.update_snapshot()
+                for lo in range(0, len(group), maxb):
+                    await self._schedule_via_backend(group[lo:lo + maxb], snapshot)
+                    snapshot = self.cache.update_snapshot()
             return
         for pi in pods:
             await self._schedule_host_path(pi, snapshot)
