@@ -1,0 +1,18 @@
+"""Mesh sharding for the scheduling tensors (SURVEY §2.8 / §5.7)."""
+
+from kubernetes_tpu.parallel.mesh import (
+    NODES_AXIS,
+    PODS_AXIS,
+    build_mesh,
+    build_mesh_2d,
+    pad_axis,
+)
+from kubernetes_tpu.parallel.sharded import (
+    sharded_greedy_assign,
+    sharded_masks_scores,
+)
+
+__all__ = [
+    "NODES_AXIS", "PODS_AXIS", "build_mesh", "build_mesh_2d", "pad_axis",
+    "sharded_greedy_assign", "sharded_masks_scores",
+]

@@ -1,0 +1,67 @@
+"""Device-mesh construction for the scheduling tensors.
+
+SURVEY §2.8/§5.7: the reference scales the Filter/Score fan-out with 16
+goroutines over the node list (framework/parallelize) and samples nodes
+(`percentageOfNodesToScore`) when clusters get big. The TPU design instead
+shards the `(P pods × N nodes)` problem matrix over a `jax.sharding.Mesh`:
+
+- **nodes axis** across chips within a slice (ICI; the TP-like axis) — masks,
+  scores, and the solver's per-step argmax reduce across it with
+  `pmax`/`pmin` collectives;
+- **pods axis** across replicas (the DP-like axis) for the embarrassingly
+  parallel mask/score phase;
+- multi-slice DCN would add an outer axis to the same specs (the 50k-node
+  config #5 path); the code below is mesh-size-agnostic — 1 chip is just a
+  (1,)-shaped mesh (SURVEY §7 hard-part #6).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+NODES_AXIS = "nodes"
+PODS_AXIS = "pods"
+
+
+def build_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the node axis (the solver's axis)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (NODES_AXIS,))
+
+
+def build_mesh_2d(n_devices: int | None = None,
+                  pods_parallelism: int | None = None) -> Mesh:
+    """(pods × nodes) mesh for the mask/score phase. Factorization favors the
+    nodes axis (N ≫ P in every BASELINE config)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    if pods_parallelism is None:
+        pods_parallelism = 1
+        for f in range(int(math.isqrt(n)), 0, -1):
+            if n % f == 0:
+                pods_parallelism = f
+                break
+    assert n % pods_parallelism == 0
+    arr = np.array(devs[:n]).reshape(pods_parallelism, n // pods_parallelism)
+    return Mesh(arr, (PODS_AXIS, NODES_AXIS))
+
+
+def pad_axis(x: np.ndarray, multiple: int, axis: int,
+             fill=0) -> np.ndarray:
+    """Pad one axis up to a multiple so it divides the mesh axis evenly."""
+    size = x.shape[axis]
+    target = math.ceil(size / multiple) * multiple
+    if target == size:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - size)
+    return np.pad(x, widths, constant_values=fill)

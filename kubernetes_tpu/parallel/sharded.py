@@ -1,0 +1,182 @@
+"""Mesh-sharded variants of the batched scheduling step.
+
+Two phases, mirroring ops/backend._mask_and_solve exactly (same inputs, same
+split of capacity-independent vs live-rescored score components):
+
+1. `sharded_masks_scores` — the (P×N) mask + static-score phase under `jit`
+   with `NamedSharding` constraints on a 2-D (pods × nodes) mesh: pure data
+   parallelism, XLA inserts no collectives beyond layout changes. This is
+   the DP×TP-analog fan-out replacing the reference's 16-goroutine
+   `parallelize.Until` (SURVEY §2.8 row 1). Returns (mask, feasible,
+   static_scores) where static_scores = host rows + weighted taint score —
+   the capacity-independent components only; fit/balanced are re-scored
+   live inside the solver.
+
+2. `sharded_greedy_assign` — the sequential-equivalent solver under
+   `shard_map` over the nodes axis: node state (free capacity, scores) lives
+   sharded; each scan step computes its shard-local best candidate and
+   resolves the global winner with `pmax`/`pmin` over ICI — the cross-shard
+   argmax reduction pattern of SURVEY §5.7. Pod vectors are replicated
+   (they're O(R) small). The winning shard debits its local capacity; the
+   chosen index is identical on every shard by construction.
+
+Both are mesh-size-agnostic (a (1,)-mesh degrades to the single-chip path)
+and compile once per (mesh, strategy) — jitted programs are cached on the
+hashable Mesh itself, with scalar weights as traced arguments.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.ops import kernels
+from kubernetes_tpu.parallel.mesh import NODES_AXIS, PODS_AXIS
+
+try:  # jax>=0.8 top-level; fall back for older versions
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+_INT_MAX = jnp.int32(2**31 - 1)
+
+_PHASE_CACHE: dict = {}
+_SOLVER_CACHE: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# phase 1: masks + static scores (2-D pods × nodes mesh)
+# ---------------------------------------------------------------------------
+
+def sharded_masks_scores(mesh: Mesh, alloc_q, used_q, used_nz_q, alloc_pods,
+                         used_pods, req_q, req_nz_q, untol_f, untol_p,
+                         taint_f_mat, taint_p_mat, static_mask, host_scores,
+                         w_taint, taint_filter_on: bool, strategy: str):
+    """Mask + capacity-independent score phase, sharded (pods × nodes).
+
+    Mirrors the first half of ops/backend._mask_and_solve: returns
+    (mask (P,N), feasible (P,N), static_scores (P,N)) with mask excluding
+    capacity (the solver re-checks capacity live) and static_scores =
+    host_scores + w_taint × taint score over the feasible set.
+    """
+    phase = _masks_scores_phase(mesh, strategy)
+    return phase(alloc_q, used_q, used_nz_q, alloc_pods, used_pods, req_q,
+                 req_nz_q, untol_f, untol_p, taint_f_mat, taint_p_mat,
+                 static_mask, host_scores, jnp.float32(w_taint),
+                 jnp.bool_(taint_filter_on))
+
+
+def _masks_scores_phase(mesh: Mesh, strategy: str):
+    """Jitted phase cached per (mesh, strategy) — pjit rejects kwargs when
+    in_shardings is given, so the static strategy lives in the closure."""
+    key = (mesh, strategy)
+    fn = _PHASE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    pn = NamedSharding(mesh, P(PODS_AXIS, NODES_AXIS))
+    n_r = NamedSharding(mesh, P(NODES_AXIS, None))
+    n_ = NamedSharding(mesh, P(NODES_AXIS))
+    p_r = NamedSharding(mesh, P(PODS_AXIS, None))
+
+    @partial(jax.jit,
+             in_shardings=(n_r, n_r, n_r, n_, n_, p_r, p_r, p_r, p_r,
+                           n_r, n_r, pn, pn, None, None),
+             out_shardings=(pn, pn, pn))
+    def phase(alloc_q, used_q, used_nz_q, alloc_pods, used_pods, req_q,
+              req_nz_q, untol_f, untol_p, taint_f_mat, taint_p_mat,
+              static_mask, host_scores, w_taint, taint_filter_on):
+        fit0 = kernels.fit_filter_mask(
+            alloc_q, used_q, used_pods, alloc_pods, req_q)
+        taint_ok = kernels.taint_filter_mask(taint_f_mat, untol_f)
+        taint_ok = taint_ok | jnp.logical_not(taint_filter_on)
+        mask = static_mask & taint_ok
+        feasible = mask & fit0
+        static_scores = host_scores + w_taint * kernels.taint_toleration_score(
+            taint_p_mat, untol_p, feasible)
+        return mask, feasible, static_scores
+
+    _PHASE_CACHE[key] = phase
+    return phase
+
+
+# ---------------------------------------------------------------------------
+# phase 2: sequential-equivalent solver (1-D nodes mesh)
+# ---------------------------------------------------------------------------
+
+def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
+                          used_nz_q, alloc_q, mask, static_scores,
+                          fit_col_w, bal_col_mask, shape_u, shape_s,
+                          w_fit, w_bal, strategy: str):
+    """Sequential-equivalent greedy with live re-scoring, node axis sharded.
+
+    Per scan step: shard-local candidate (max score, min index among ties) →
+    global winner via `pmax` then `pmin` over the nodes axis → winning shard
+    debits capacity. Semantics match ops/solver.greedy_assign_rescoring
+    exactly (ties → lowest global node index)."""
+    n_shards = mesh.shape[NODES_AXIS]
+    n_total = free_q.shape[0]
+    assert n_total % n_shards == 0, (n_total, n_shards)
+    run = _solver_fn(mesh, strategy, n_total // n_shards)
+    return run(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+               mask, static_scores, fit_col_w, bal_col_mask,
+               jnp.asarray(shape_u), jnp.asarray(shape_s),
+               jnp.float32(w_fit), jnp.float32(w_bal))
+
+
+def _solver_fn(mesh: Mesh, strategy: str, local_n: int):
+    key = (mesh, strategy, local_n)
+    fn = _SOLVER_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    spec_nr = P(NODES_AXIS, None)
+    spec_n = P(NODES_AXIS)
+    spec_pn = P(None, NODES_AXIS)
+    rep = P()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(rep, rep, spec_nr, spec_n, spec_nr, spec_nr,
+                       spec_pn, spec_pn, rep, rep, rep, rep, rep, rep),
+             out_specs=rep, check_vma=False)
+    def run(req_q, req_nz_q, free_q, free_pods, used_nz, alloc_q,
+            mask, static_sc, fit_col_w, bal_col_mask, shape_u, shape_s,
+            w_fit, w_bal):
+        shard = lax.axis_index(NODES_AXIS)
+        base = (shard * local_n).astype(jnp.int32)
+        iota = jnp.arange(local_n, dtype=jnp.int32)
+
+        def step(carry, inp):
+            free_q, free_pods, used_nz = carry
+            req, req_nz, m, sc_static = inp
+            fits = m & jnp.all(req[None, :] <= free_q, axis=1) & (free_pods >= 1)
+            sc = sc_static
+            sc = sc + w_fit * kernels.fit_score(
+                alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
+                shape_u, shape_s)[0]
+            sc = sc + w_bal * kernels.balanced_allocation_score(
+                alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
+            masked = jnp.where(fits, sc, -jnp.inf)
+            lbest = jnp.max(masked)
+            gbest = lax.pmax(lbest, NODES_AXIS)
+            # Tie-break: lowest global index among shards holding gbest.
+            cand = jnp.where(masked >= gbest, iota + base, _INT_MAX)
+            gidx = lax.pmin(jnp.min(cand), NODES_AXIS)
+            chosen = jnp.where(jnp.isfinite(gbest), gidx, jnp.int32(-1))
+            hit = (iota + base) == chosen
+            free_q = free_q - jnp.where(hit[:, None], req[None, :], 0)
+            free_pods = free_pods - hit.astype(jnp.int32)
+            used_nz = used_nz + jnp.where(hit[:, None], req_nz[None, :], 0)
+            return (free_q, free_pods, used_nz), chosen
+
+        (_, _, _), assign = lax.scan(
+            step, (free_q, free_pods, used_nz),
+            (req_q, req_nz_q, mask, static_sc))
+        return assign
+
+    _SOLVER_CACHE[key] = run
+    return run

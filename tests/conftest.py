@@ -9,12 +9,21 @@ Must set XLA_FLAGS/JAX_PLATFORMS before jax initializes, hence top of conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the session's axon sitecustomize pins
+# jax.config jax_platforms="axon,cpu" (the one real TPU) at interpreter
+# start, overriding the env var — so override the *config* after import.
+# KTPU_TEST_PLATFORM runs the suite against real hardware instead.
+_platform = os.environ.get("KTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
 
 import asyncio  # noqa: E402
 
