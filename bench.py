@@ -1,0 +1,81 @@
+"""Headline benchmark: pods scheduled/sec at scale (BASELINE.json metric).
+
+Runs the scheduler_perf SchedulingBasic workload (in-process store + real
+scheduler + informers, Node objects as data — no kubelets, the reference's
+own trick) with the TPU batch backend, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N/ref}
+
+Baseline: the reference's default-scheduler sustains ~100–300 pods/s on
+scheduler_perf (BASELINE.md); vs_baseline uses 300 — the top of the
+published envelope — so the ratio is conservative.
+
+Presets: --preset smoke (100 nodes/1k pods, quick), --preset 1k,
+--preset 5k (default; the BASELINE headline config).
+Options: --backend host|tpu (default tpu), --batch-size (default 256).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+REFERENCE_PODS_PER_SEC = 300.0
+
+PRESETS = {
+    #       nodes, warmup pods, measured pods
+    "smoke": (100, 200, 1000),
+    "1k": (1000, 500, 3000),
+    "5k": (5000, 1000, 10000),
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", choices=PRESETS, default="5k")
+    ap.add_argument("--backend", choices=["host", "tpu"], default="tpu")
+    ap.add_argument("--batch-size", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    from kubernetes_tpu.perf.scheduler_perf import PerfRunner
+
+    nodes, warmup, measured = PRESETS[args.preset]
+    backend = None
+    batch = 1
+    if args.backend == "tpu":
+        from kubernetes_tpu.ops import TPUBackend
+        backend = TPUBackend(max_batch=args.batch_size)
+        batch = args.batch_size
+
+    # Warmup phase triggers jit compilation (first TPU compile is ~20-40s)
+    # before the measured phase starts.
+    template = [
+        {"opcode": "createNodes", "countParam": "$nodes"},
+        {"opcode": "createPods", "countParam": "$warmup"},
+        {"opcode": "barrier"},
+        {"opcode": "createPods", "countParam": "$measured",
+         "collectMetrics": True},
+        {"opcode": "barrier"},
+    ]
+    params = {"nodes": nodes, "warmup": warmup, "measured": measured}
+
+    runner = PerfRunner(backend=backend, batch_size=batch)
+    res = asyncio.run(runner.run(template, params, timeout=1800.0))
+
+    detail = res.as_dict()
+    print(json.dumps({"detail": detail, "preset": args.preset,
+                      "backend": args.backend}, ), file=sys.stderr)
+    print(json.dumps({
+        "metric": f"pods_per_sec_{args.preset}_nodes_{args.backend}",
+        "value": detail["throughput_pods_per_sec"],
+        "unit": "pods/s",
+        "vs_baseline": round(
+            detail["throughput_pods_per_sec"] / REFERENCE_PODS_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -91,6 +91,31 @@ class Histogram:
                 return self.buckets[i]
         return self.buckets[-1]
 
+    def snapshot(self, **labels: str) -> tuple[list[int], int]:
+        """(bucket counts, total) at this instant — pair with
+        percentile_since for windowed percentiles (bench measured phase)."""
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        return list(self._counts.get(key) or [0] * len(self.buckets)), \
+            self._totals.get(key, 0)
+
+    def percentile_since(self, q: float, base: tuple[list[int], int],
+                         **labels: str) -> float:
+        """Percentile over observations made after `base = snapshot()`."""
+        key = tuple(labels.get(n, "") for n in self.label_names)
+        counts = self._counts.get(key)
+        base_counts, base_total = base
+        total = self._totals.get(key, 0) - base_total
+        if not counts or total <= 0:
+            return math.nan
+        delta = [c - b for c, b in zip(counts, base_counts)]
+        rank = q * total
+        cum = 0
+        for i in range(len(delta)):
+            cum += delta[i] if i == 0 else (delta[i] - delta[i - 1])
+            if cum >= rank:
+                return self.buckets[i]
+        return self.buckets[-1]
+
     def count(self, **labels: str) -> int:
         key = tuple(labels.get(n, "") for n in self.label_names)
         return self._totals.get(key, 0)

@@ -58,6 +58,30 @@ DEVICE_SCORE_PLUGINS = {
 STATIC_ROW_PLUGINS = {"NodeAffinity", "NodeName", "NodeUnschedulable"}
 STATIC_SCORE_PLUGINS = {"NodeAffinity", "ImageLocality"}
 
+#: O(1)-per-pod activity gates mirroring each stateful plugin's own
+#: PreFilter/PreScore Skip condition. Without these, merely *asking* a plugin
+#: to skip costs O(N) per pod (e.g. InterPodAffinity.pre_score scans all
+#: nodes for pods-with-affinity before skipping) — the 5k-node profile's top
+#: hotspot. Invariant: a gate may only say "inactive" when the plugin would
+#: Skip — PodTopologySpread's gate therefore asks the plugin for its
+#: effective constraints (system/profile DEFAULT constraints apply to
+#: labeled pods even with no explicit spec constraints).
+_FILTER_ACTIVE = {
+    "InterPodAffinity": lambda plugin, pi, snap: bool(
+        pi.required_affinity_terms or pi.required_anti_affinity_terms
+        or snap.have_pods_with_required_anti_affinity),
+    "PodTopologySpread": lambda plugin, pi, snap: bool(
+        plugin._constraints_for(pi, "DoNotSchedule")),
+    "NodePorts": lambda plugin, pi, snap: bool(pi.host_ports),
+}
+_SCORE_ACTIVE = {
+    "InterPodAffinity": lambda plugin, pi, snap: bool(
+        pi.preferred_affinity_terms or pi.preferred_anti_affinity_terms
+        or snap.have_pods_with_affinity),
+    "PodTopologySpread": lambda plugin, pi, snap: bool(
+        plugin._constraints_for(pi, "ScheduleAnyway")),
+}
+
 
 def _signature(plugin_name: str, pi: PodInfo) -> str:
     if plugin_name == "NodeName":
@@ -217,10 +241,14 @@ class TPUBackend:
         # stateful irregular plugins (per pod, Skip-gated).
         dyn_states: dict[int, CycleState] = {}
         host_filter_fail: dict[str, np.ndarray] = {}  # plugin -> (P,N) ok-mask
+        #: pods whose dynamic-plugin filter gate fired (need post-solve
+        #: re-verification against earlier batch placements).
+        stateful_pods: set[int] = set()
 
         def apply_row(pname: str, i: int, row: np.ndarray) -> None:
-            ok = host_filter_fail.setdefault(
-                pname, np.ones((P, N), dtype=np.bool_))
+            ok = host_filter_fail.get(pname)
+            if ok is None:  # setdefault would allocate the array per call
+                ok = host_filter_fail[pname] = np.ones((P, N), dtype=np.bool_)
             ok[i, : ct.n_real] &= row
             static_mask[i, : ct.n_real] &= row
 
@@ -234,13 +262,22 @@ class TPUBackend:
                     apply_row(plugin.NAME, i,
                               self._static_filter_row(plugin, pi, snapshot, ct))
             else:
+                gate = _FILTER_ACTIVE.get(plugin.NAME)
                 for i, pi in enumerate(pods):
                     if i in unknown_res:
+                        continue
+                    if gate is not None and not gate(plugin, pi, snapshot):
                         continue
                     state = dyn_states.setdefault(i, CycleState())
                     row = self._dynamic_filter_row(plugin, pi, snapshot, ct, state)
                     if row is not None:
                         apply_row(plugin.NAME, i, row)
+                    # NodePorts conflicts only affect pods with ports (each
+                    # is individually re-verified); cross-pod plugins flip
+                    # the whole batch into full re-verification. row None
+                    # means the plugin itself skipped after all.
+                    if plugin.NAME != "NodePorts" and row is not None:
+                        stateful_pods.add(i)
 
         # Host score rows: computed over each pod's *feasible* node set only
         # (PreScore/Score receive filtered nodes in the reference), then the
@@ -284,6 +321,9 @@ class TPUBackend:
                     raw = {ct.node_names[j]: float(row[j])
                            for j in feasible_idx(i)}
                 else:
+                    gate = _SCORE_ACTIVE.get(name)
+                    if gate is not None and not gate(plugin, pi, snapshot):
+                        continue
                     state = dyn_states.setdefault(i, CycleState())
                     nodes_i = [snapshot.nodes[j] for j in feasible_idx(i)]
                     st = plugin.pre_score(state, pi, nodes_i)
@@ -339,7 +379,7 @@ class TPUBackend:
 
         # Host verify + working-state accumulation (hard part #1).
         assignments, diagnostics = self._verify(
-            pods, assign, snapshot, fwk, ct, dyn_states)
+            pods, assign, snapshot, fwk, ct, stateful_pods)
 
         # Lazy per-plugin diagnostics for unassigned pods.
         need_diag = [i for i, pi in enumerate(pods)
@@ -354,7 +394,7 @@ class TPUBackend:
 
     # -- verification --------------------------------------------------------
 
-    def _verify(self, pods, assign, snapshot, fwk, ct, dyn_states):
+    def _verify(self, pods, assign, snapshot, fwk, ct, stateful_pods):
         assignments: dict[str, str | None] = {}
         diagnostics: dict[str, dict[str, Status]] = {}
         working: dict[str, NodeInfo] = {}
@@ -367,14 +407,13 @@ class TPUBackend:
                 working[name] = ni
             return ni
 
-        # If ANY batch pod carries required (anti-)affinity or spread
-        # constraints, later placements can invalidate earlier host rows
-        # (including for pods with no constraints of their own — anti-affinity
-        # symmetry), so every placement after the first such pod gets the
-        # full plugin re-check against the working snapshot.
-        stateful_batch = any(
-            pi.required_affinity_terms or pi.required_anti_affinity_terms
-            or pi.topology_spread_constraints for pi in pods)
+        # If ANY batch pod activated a stateful filter plugin (gate fired —
+        # explicit constraints or profile defaults), later placements can
+        # invalidate earlier host rows, including for pods with no
+        # constraints of their own (anti-affinity symmetry) — so every
+        # placement gets the full plugin re-check against the working
+        # snapshot in that case.
+        stateful_batch = bool(stateful_pods)
 
         contention = Status.unschedulable(
             "node(s) exhausted by earlier pods in the batch"
@@ -393,8 +432,8 @@ class TPUBackend:
                 diagnostics[pi.key] = {ni.name: contention}
                 continue
             # Stateful plugins must see earlier batch placements.
-            if stateful_batch or pi.has_affinity_constraints \
-                    or pi.topology_spread_constraints or pi.host_ports:
+            if stateful_batch or i in stateful_pods \
+                    or pi.has_affinity_constraints or pi.host_ports:
                 wsnap = Snapshot(
                     [working.get(n.name, n) for n in snapshot.nodes],
                     snapshot.generation)

@@ -165,12 +165,35 @@ class ClusterTensors:
         self.scales = [_scale_for(m) for m in max_alloc]
 
         N, sc = self.n_pad, self.scales
-        self.alloc_q = np.zeros((N, R), dtype=np.int32)
-        self.used_q = np.zeros((N, R), dtype=np.int32)
-        self.used_nz_q = np.zeros((N, R), dtype=np.int32)  # nonzero-defaults view (Score)
-        self.alloc_pods = np.zeros((N,), dtype=np.int32)
-        self.used_pods = np.zeros((N,), dtype=np.int32)
-        for i, ni in enumerate(nodes):
+        self.node_gens = [ni.generation for ni in nodes]
+
+        # Incremental path (the UpdateSnapshot generation walk, SURVEY §2.3):
+        # when the node set and columns are unchanged vs the previous
+        # tensors, copy the previous arrays and re-quantize only nodes whose
+        # generation advanced — per steady-state cycle that's ≤ the batch of
+        # pods just assumed, not all N nodes. Fresh copies, never in-place:
+        # jnp.asarray may alias numpy memory on the CPU backend.
+        incremental = (
+            prev is not None and prev.node_names == self.node_names
+            and prev.resources == self.resources and prev.n_pad == N
+            and prev.scales == self.scales)
+        if incremental:
+            self.alloc_q = prev.alloc_q.copy()
+            self.used_q = prev.used_q.copy()
+            self.used_nz_q = prev.used_nz_q.copy()
+            self.alloc_pods = prev.alloc_pods.copy()
+            self.used_pods = prev.used_pods.copy()
+            changed = [i for i, g in enumerate(self.node_gens)
+                       if prev.node_gens[i] != g]
+        else:
+            self.alloc_q = np.zeros((N, R), dtype=np.int32)
+            self.used_q = np.zeros((N, R), dtype=np.int32)
+            self.used_nz_q = np.zeros((N, R), dtype=np.int32)
+            self.alloc_pods = np.zeros((N,), dtype=np.int32)
+            self.used_pods = np.zeros((N,), dtype=np.int32)
+            changed = range(len(nodes))
+        for i in changed:
+            ni = nodes[i]
             for j, r in enumerate(self.resources):
                 self.alloc_q[i, j] = _quant_floor(ni.allocatable.get(r), sc[j])
                 self.used_q[i, j] = _quant_ceil(ni.requested.get(r), sc[j])
@@ -184,7 +207,9 @@ class ClusterTensors:
         self.valid[: self.n_real] = True
 
         # Taints: reuse the interning when the static fingerprint matches.
-        fp = tuple((ni.name, id(ni.node)) for ni in nodes)
+        # Keyed on the monotonic spec_epoch (NOT id(node): a recycled dict
+        # address could falsely match and serve stale taint matrices).
+        fp = tuple((ni.name, ni.spec_epoch) for ni in nodes)
         if prev is not None and prev._static_fp == fp and prev.n_pad == N:
             self.taints = prev.taints
             self.taint_filter_mat = prev.taint_filter_mat

@@ -1,0 +1,5 @@
+"""scheduler_perf harness (SURVEY §3.5)."""
+
+from kubernetes_tpu.perf.scheduler_perf import PerfRunner, WorkloadResult, run_suite
+
+__all__ = ["PerfRunner", "WorkloadResult", "run_suite"]

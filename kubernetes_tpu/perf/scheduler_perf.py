@@ -1,0 +1,313 @@
+"""scheduler_perf: the reference's scale benchmark harness, YAML-compatible.
+
+Parity target: test/integration/scheduler_perf/ (scheduler_perf.go,
+config/performance-config.yaml — SURVEY §3.5). Same trick: in-process
+control plane, **no kubelets** — Node objects are data, pods "run" because
+nothing contradicts Bind. Same workload YAML shape:
+
+    - name: SchedulingBasic
+      workloadTemplate:
+      - opcode: createNodes
+        countParam: $initNodes
+        nodeTemplate: {...}            # inline instead of nodeTemplatePath
+      - opcode: createPods
+        countParam: $initPods
+        podTemplate: {...}
+      - opcode: createPods
+        countParam: $measurePods
+        collectMetrics: true           # the measured phase
+      - opcode: barrier                # wait until all created pods scheduled
+      workloads:
+      - name: 100Nodes
+        params: {initNodes: 100, initPods: 500, measurePods: 1000}
+
+Opcodes: createNodes, createPods, barrier, sleep, churn (delete/recreate a
+slice of pods for queue pressure). Metrics collected over the measured
+phase: SchedulingThroughput (pods/s), scheduling_attempt_duration
+percentiles (p50/p90/p99 from the scheduler's own histogram — SURVEY §5.5
+names), and node fragmentation % (mean free-capacity fraction; the
+bin-packing quality metric BASELINE tracks).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import time
+from typing import Any, Mapping
+
+from kubernetes_tpu.api.meta import namespaced_name
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.metrics.registry import SchedulerMetrics
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def _subst(value: Any, params: Mapping[str, Any]) -> Any:
+    """$param substitution (countParam etc.)."""
+    if isinstance(value, str) and value.startswith("$"):
+        return params[value[1:]]
+    return value
+
+
+def _resolve_count(op: Mapping, params: Mapping[str, Any]) -> int:
+    if "countParam" in op:
+        return int(_subst(op["countParam"], params))
+    return int(op.get("count", 0))
+
+
+class WorkloadResult:
+    def __init__(self):
+        self.throughput = 0.0          # pods/s over the measured phase
+        self.measured_pods = 0
+        self.measured_seconds = 0.0
+        self.attempt_p50 = 0.0
+        self.attempt_p90 = 0.0
+        self.attempt_p99 = 0.0
+        self.fragmentation_pct = 0.0
+        self.scheduled_total = 0
+        self.unschedulable_total = 0
+
+    def as_dict(self) -> dict:
+        import math
+
+        def ms(v: float):
+            return None if math.isnan(v) else round(v * 1e3, 3)
+
+        return {
+            "throughput_pods_per_sec": round(self.throughput, 2),
+            "measured_pods": self.measured_pods,
+            "measured_seconds": round(self.measured_seconds, 3),
+            "attempt_p50_ms": ms(self.attempt_p50),
+            "attempt_p90_ms": ms(self.attempt_p90),
+            "attempt_p99_ms": ms(self.attempt_p99),
+            "fragmentation_pct": round(self.fragmentation_pct, 2),
+            "scheduled_total": self.scheduled_total,
+            "unschedulable_total": self.unschedulable_total,
+        }
+
+
+DEFAULT_NODE_TEMPLATE = {
+    "allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}}
+DEFAULT_POD_TEMPLATE = {
+    "requests": {"cpu": "100m", "memory": "250Mi"}}
+
+
+class PerfRunner:
+    """Executes one workload (template ops + params) against an in-process
+    store + scheduler, mirroring mustSetupCluster → runWorkload."""
+
+    def __init__(self, backend=None, batch_size: int = 1,
+                 scheduler_kwargs: Mapping | None = None):
+        self.backend = backend
+        self.batch_size = batch_size
+        self.scheduler_kwargs = dict(scheduler_kwargs or {})
+
+    async def run(self, template_ops: list, params: Mapping[str, Any],
+                  timeout: float = 600.0) -> WorkloadResult:
+        store = new_cluster_store()
+        install_core_validation(store)
+        metrics = SchedulerMetrics()
+        sched = Scheduler(store, seed=42, backend=self.backend,
+                          metrics=metrics, **self.scheduler_kwargs)
+        factory = InformerFactory(store)
+        await sched.setup_informers(factory)
+
+        # Bound-pod accounting via watch events, not store LISTs: a LIST
+        # deep-copies every object and was the harness's own hot spot.
+        bound_keys: set[str] = set()
+
+        def _track(obj):
+            if obj.get("spec", {}).get("nodeName"):
+                bound_keys.add(namespaced_name(obj))
+
+        from kubernetes_tpu.client import ResourceEventHandler
+        factory.informer("pods").add_event_handler(ResourceEventHandler(
+            on_add=_track, on_update=lambda old, new: _track(new),
+            on_delete=lambda obj: bound_keys.discard(namespaced_name(obj))))
+
+        factory.start()
+        await factory.wait_for_sync()
+        run_task = asyncio.ensure_future(sched.run(batch_size=self.batch_size))
+
+        result = WorkloadResult()
+        node_count = 0
+        pod_seq = 0
+        created_total = 0
+        deadline = time.monotonic() + timeout
+        try:
+            for op in template_ops:
+                opcode = op["opcode"]
+                if opcode == "createNodes":
+                    count = _resolve_count(op, params)
+                    tmpl = {**DEFAULT_NODE_TEMPLATE,
+                            **(op.get("nodeTemplate") or {})}
+                    for i in range(count):
+                        await store.create("nodes", make_node(
+                            f"node-{node_count + i}", **copy.deepcopy(tmpl)))
+                    node_count += count
+
+                elif opcode == "createPods":
+                    count = _resolve_count(op, params)
+                    tmpl = {**DEFAULT_POD_TEMPLATE,
+                            **(op.get("podTemplate") or {})}
+                    measured = bool(op.get("collectMetrics"))
+                    if measured:
+                        # Metric window starts now: percentiles and
+                        # throughput cover only the measured phase (warmup
+                        # attempts — including jit compile — are excluded).
+                        hist_base = metrics.attempt_duration.snapshot(
+                            result="scheduled", profile="default-scheduler")
+                        t0 = time.monotonic()
+                    for i in range(count):
+                        await store.create("pods", make_pod(
+                            f"pod-{pod_seq + i}", **copy.deepcopy(tmpl)))
+                    pod_seq += count
+                    created_total += count
+                    if measured:
+                        await self._wait_bound(bound_keys, created_total,
+                                               deadline)
+                        dt = time.monotonic() - t0
+                        result.measured_pods = count
+                        result.measured_seconds = dt
+                        result.throughput = count / dt if dt > 0 else 0.0
+                        h = metrics.attempt_duration
+                        labels = {"result": "scheduled",
+                                  "profile": "default-scheduler"}
+                        result.attempt_p50 = h.percentile_since(
+                            0.50, hist_base, **labels)
+                        result.attempt_p90 = h.percentile_since(
+                            0.90, hist_base, **labels)
+                        result.attempt_p99 = h.percentile_since(
+                            0.99, hist_base, **labels)
+
+                elif opcode == "barrier":
+                    await self._wait_bound(bound_keys, created_total, deadline)
+
+                elif opcode == "sleep":
+                    await asyncio.sleep(float(
+                        _subst(op.get("duration", 0), params)))
+
+                elif opcode == "churn":
+                    # Delete + recreate a slice of bound pods: queue pressure
+                    # and cache-update load (reference churnOp).
+                    count = _resolve_count(op, params)
+                    pods = (await store.list("pods")).items[:count]
+                    for p in pods:
+                        await store.delete("pods", namespaced_name(p))
+                    created_total -= len(pods)
+                    # Wait for the deletions to reach the informer before
+                    # recreating, or the next barrier reads stale bound keys.
+                    while len(bound_keys) > created_total \
+                            and time.monotonic() < deadline:
+                        await asyncio.sleep(0.01)
+                    tmpl = {**DEFAULT_POD_TEMPLATE,
+                            **(op.get("podTemplate") or {})}
+                    for i in range(len(pods)):
+                        await store.create("pods", make_pod(
+                            f"pod-{pod_seq + i}", **copy.deepcopy(tmpl)))
+                    pod_seq += len(pods)
+                    created_total += len(pods)
+
+                else:
+                    raise ValueError(f"unknown opcode {opcode!r}")
+        finally:
+            await sched.stop()
+            run_task.cancel()
+            factory.stop()
+            store.stop()
+
+        # Percentiles were captured over the measured window above
+        # (scheduler_scheduling_attempt_duration_seconds — SURVEY §5.5);
+        # fall back to whole-run percentiles when no phase was measured.
+        if result.measured_pods == 0:
+            h = metrics.attempt_duration
+            labels = {"result": "scheduled", "profile": "default-scheduler"}
+            result.attempt_p50 = h.percentile(0.50, **labels)
+            result.attempt_p90 = h.percentile(0.90, **labels)
+            result.attempt_p99 = h.percentile(0.99, **labels)
+        result.scheduled_total = _result_count(metrics, "scheduled")
+        result.unschedulable_total = _result_count(metrics, "unschedulable")
+        result.fragmentation_pct = self._fragmentation(sched)
+        return result
+
+    async def _wait_bound(self, bound_keys: set, want: int,
+                          deadline: float) -> None:
+        """barrierOp: block until every created pod has a nodeName."""
+        while time.monotonic() < deadline:
+            if len(bound_keys) >= want:
+                return
+            await asyncio.sleep(0.01)
+        raise TimeoutError(
+            f"barrier: {len(bound_keys)}/{want} pods bound at timeout")
+
+    @staticmethod
+    def _fragmentation(sched: Scheduler) -> float:
+        """Mean free-capacity fraction across nodes (%, lower = tighter)."""
+        snapshot = sched.cache.update_snapshot()
+        if not len(snapshot):
+            return 0.0
+        total = 0.0
+        for ni in snapshot:
+            fracs = []
+            for r, alloc in ni.allocatable.res.items():
+                if alloc > 0:
+                    fracs.append(
+                        max(0.0, (alloc - ni.requested.get(r)) / alloc))
+            total += sum(fracs) / len(fracs) if fracs else 1.0
+        return 100.0 * total / len(snapshot)
+
+
+def _result_count(metrics: SchedulerMetrics, result: str) -> int:
+    return int(metrics.schedule_attempts.value(
+        result=result, profile="default-scheduler"))
+
+
+def load_config(path: str) -> list[dict]:
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def run_suite(config: list[dict], backend_factory=None, batch_size: int = 1,
+              filter_name: str | None = None) -> dict[str, dict]:
+    """Run every (testcase × workload) pair, like BenchmarkPerfScheduling."""
+    out: dict[str, dict] = {}
+    for case in config:
+        for wl in case.get("workloads") or [{"name": "default", "params": {}}]:
+            full = f"{case['name']}/{wl['name']}"
+            if filter_name and filter_name not in full:
+                continue
+            backend = backend_factory() if backend_factory else None
+            runner = PerfRunner(backend=backend, batch_size=batch_size)
+            res = asyncio.run(runner.run(
+                case["workloadTemplate"], wl.get("params") or {}))
+            out[full] = res.as_dict()
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("config", help="workload YAML")
+    ap.add_argument("--backend", choices=["host", "tpu"], default="host")
+    ap.add_argument("--batch-size", type=int, default=1)
+    ap.add_argument("--filter", default=None)
+    args = ap.parse_args(argv)
+
+    factory = None
+    batch = args.batch_size
+    if args.backend == "tpu":
+        from kubernetes_tpu.ops import TPUBackend
+        factory = lambda: TPUBackend(max_batch=max(batch, 2))  # noqa: E731
+        batch = max(batch, 128)
+    results = run_suite(load_config(args.config), backend_factory=factory,
+                        batch_size=batch, filter_name=args.filter)
+    print(json.dumps(results, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -155,7 +155,7 @@ class NodeInfo:
         "node", "name", "labels", "allocatable", "taints", "unschedulable",
         "requested", "nonzero_requested", "pods", "pods_with_affinity",
         "pods_with_required_anti_affinity", "used_ports", "image_names",
-        "generation",
+        "generation", "spec_epoch",
     )
 
     def __init__(self, node: Mapping | None = None):
@@ -183,6 +183,12 @@ class NodeInfo:
                 for tag in img.get("names") or []:
                     self.image_names.add(tag)
         self.generation = 0
+        # Monotonic count of node-object (spec/labels/taints) changes —
+        # unlike `generation` it does NOT move on pod add/remove, so
+        # consumers keyed on static node state (the TPU backend's taint
+        # interning and signature-cached rows) can reuse work across
+        # pod-churn cycles without the id()-recycling hazard.
+        self.spec_epoch = 1 if node else 0
 
     def set_node(self, node: Mapping) -> None:
         self.node = node
@@ -199,6 +205,7 @@ class NodeInfo:
         for img in node.get("status", {}).get("images") or []:
             for tag in img.get("names") or []:
                 self.image_names.add(tag)
+        self.spec_epoch += 1
 
     def add_pod(self, pi: PodInfo) -> None:
         self.pods.append(pi)
@@ -241,6 +248,7 @@ class NodeInfo:
         ni.used_ports = set(self.used_ports)
         ni.image_names = set(self.image_names)
         ni.generation = self.generation
+        ni.spec_epoch = self.spec_epoch
         return ni
 
     def __repr__(self) -> str:

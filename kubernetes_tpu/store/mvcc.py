@@ -217,7 +217,14 @@ class MVCCStore:
         rv = self._next_rv()
         obj["metadata"]["resourceVersion"] = str(rv)
         table[key] = obj
-        self._record(resource, Event("ADDED", deep_copy(obj), rv))
+        # The watch event SHARES the stored object: watch consumers must
+        # never mutate delivered objects — the convention client-go's shared
+        # informer imposes (handlers all receive the one cached object).
+        # Updates never mutate stored objects in place (they replace
+        # table[key]), so shared references stay frozen at their RV. The
+        # *returned* object stays a private copy: read-modify-write on it is
+        # idiomatic for callers.
+        self._record(resource, Event("ADDED", obj, rv))
         return deep_copy(obj)
 
     async def get(self, resource: str, key: str) -> dict:
@@ -250,7 +257,8 @@ class MVCCStore:
         obj["metadata"]["resourceVersion"] = str(rv)
         prev_labels = dict(current.get("metadata", {}).get("labels") or {})
         table[key] = obj
-        self._record(resource, Event("MODIFIED", deep_copy(obj), rv, prev_labels))
+        # Shared-object discipline: see create().
+        self._record(resource, Event("MODIFIED", obj, rv, prev_labels))
         return deep_copy(obj)
 
     async def guaranteed_update(

@@ -1,0 +1,92 @@
+"""scheduler_perf harness: workload execution, metrics, YAML suite."""
+
+import asyncio
+
+from kubernetes_tpu.ops import TPUBackend
+from kubernetes_tpu.perf import PerfRunner, run_suite
+
+
+class TestPerfRunner:
+    def test_basic_workload_host(self):
+        template = [
+            {"opcode": "createNodes", "countParam": "$nodes"},
+            {"opcode": "createPods", "count": 20, "collectMetrics": True},
+            {"opcode": "barrier"},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {"nodes": 5},
+                                           timeout=30.0))
+        d = res.as_dict()
+        assert d["scheduled_total"] >= 20
+        assert d["throughput_pods_per_sec"] > 0
+        assert 0 < d["fragmentation_pct"] <= 100
+
+    def test_basic_workload_tpu_backend(self):
+        template = [
+            {"opcode": "createNodes", "count": 8},
+            {"opcode": "createPods", "count": 40, "collectMetrics": True},
+            {"opcode": "barrier"},
+        ]
+        runner = PerfRunner(backend=TPUBackend(max_batch=16), batch_size=16)
+        res = asyncio.run(runner.run(template, {}, timeout=60.0))
+        assert res.scheduled_total >= 40
+
+    def test_unschedulable_pods_counted(self):
+        template = [
+            {"opcode": "createNodes", "count": 2},
+            {"opcode": "createPods", "count": 3,
+             "podTemplate": {"requests": {"cpu": "100"}}},
+            {"opcode": "createPods", "count": 10, "collectMetrics": True},
+            {"opcode": "barrier"},
+        ]
+        # barrier waits for all 13 but 3 can never schedule → rely on the
+        # measured phase's own wait; barrier then times out… so use a
+        # template without the trailing barrier for the huge pods.
+        template = [
+            {"opcode": "createNodes", "count": 2},
+            {"opcode": "createPods", "count": 10, "collectMetrics": True},
+            {"opcode": "barrier"},
+            {"opcode": "createPods", "count": 3,
+             "podTemplate": {"requests": {"cpu": "100"}}},
+            {"opcode": "sleep", "duration": 0.3},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {}, timeout=30.0))
+        assert res.scheduled_total >= 10
+        assert res.unschedulable_total >= 3
+
+    def test_churn_op(self):
+        template = [
+            {"opcode": "createNodes", "count": 4},
+            {"opcode": "createPods", "count": 20},
+            {"opcode": "barrier"},
+            {"opcode": "churn", "count": 5},
+            {"opcode": "barrier"},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {}, timeout=30.0))
+        assert res.scheduled_total >= 25  # 20 initial + 5 recreated
+
+
+class TestSuiteConfig:
+    def test_yaml_suite_smallest(self, tmp_path):
+        import yaml
+        cfg = [{
+            "name": "Tiny",
+            "workloadTemplate": [
+                {"opcode": "createNodes", "countParam": "$n"},
+                {"opcode": "createPods", "count": 10, "collectMetrics": True},
+                {"opcode": "barrier"},
+            ],
+            "workloads": [{"name": "5Nodes", "params": {"n": 5}}],
+        }]
+        p = tmp_path / "cfg.yaml"
+        p.write_text(yaml.safe_dump(cfg))
+        from kubernetes_tpu.perf.scheduler_perf import load_config
+        results = run_suite(load_config(str(p)))
+        assert "Tiny/5Nodes" in results
+        assert results["Tiny/5Nodes"]["scheduled_total"] >= 10
+
+    def test_repo_config_parses(self):
+        from kubernetes_tpu.perf.scheduler_perf import load_config
+        cfg = load_config("kubernetes_tpu/perf/config/performance-config.yaml")
+        names = {c["name"] for c in cfg}
+        assert {"SchedulingBasic", "SchedulingNodeAffinity",
+                "SchedulingTaints", "Unschedulable"} <= names
