@@ -56,6 +56,19 @@ class Indexer:
         keys = self._indices.get(index_name, {}).get(value, ())
         return [self._objects[k] for k in keys]
 
+    def add_indexer(self, name: str,
+                    fn: Callable[[Mapping], list[str]]) -> None:
+        """Register a named index after construction (AddIndexers); existing
+        objects are back-filled. Idempotent for the same name."""
+        if name in self._indexers:
+            return
+        self._indexers[name] = fn
+        idx: dict[str, set[str]] = {}
+        self._indices[name] = idx
+        for key, obj in self._objects.items():
+            for v in fn(obj):
+                idx.setdefault(v, set()).add(key)
+
     def _update_indices(self, key: str, old: Mapping | None, new: Mapping | None) -> None:
         for name, fn in self._indexers.items():
             idx = self._indices[name]

@@ -1,0 +1,21 @@
+"""Controllers tier: reconcile loops over the store (SURVEY §2.4/§3.4)."""
+
+from kubernetes_tpu.controllers.base import Controller, ControllerManager
+from kubernetes_tpu.controllers.deployment import (
+    DeploymentController,
+    make_deployment,
+)
+from kubernetes_tpu.controllers.kwok import KwokController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.replicaset import (
+    ReplicaSetController,
+    make_replicaset,
+)
+
+__all__ = [
+    "Controller", "ControllerManager",
+    "DeploymentController", "make_deployment",
+    "KwokController", "NodeLifecycleController", "PodGCController",
+    "ReplicaSetController", "make_replicaset",
+]

@@ -1,0 +1,114 @@
+"""KWOK-style fake node controller: thousands of nodes without kubelets.
+
+Parity target: kubernetes-sigs/kwok (SURVEY §4 "Scale simulation" row) +
+cmd/kubemark hollow nodes: register N Node objects, renew their coordination
+Leases on the kubelet cadence, and fake the pod lifecycle (bound pods are
+marked Running, and terminate when deleted). This is what makes 5k/50k-node
+configs runnable on one host with the REAL control plane (store, scheduler,
+controllers all unmodified).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from kubernetes_tpu.api.meta import namespaced_name, new_object
+from kubernetes_tpu.api.types import make_node
+from kubernetes_tpu.client import InformerFactory, ResourceEventHandler
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.store.mvcc import AlreadyExists, NotFound, StoreError
+
+logger = logging.getLogger(__name__)
+
+
+class KwokController(Controller):
+    NAME = "kwok"
+    WORKERS = 2
+
+    def __init__(self, store, *, node_count: int = 0,
+                 node_template: dict | None = None,
+                 lease_period: float = 2.0,
+                 name_prefix: str = "kwok-node-"):
+        super().__init__(store)
+        self.node_count = node_count
+        self.node_template = node_template or {}
+        self.lease_period = lease_period
+        self.name_prefix = name_prefix
+        self._managed: set[str] = set()
+
+    def setup(self, factory: InformerFactory) -> None:
+        self.pod_informer = factory.informer("pods")
+
+        def on_pod(obj):
+            # Fake kubelet: a pod bound to a managed node starts "Running".
+            node = obj.get("spec", {}).get("nodeName")
+            if node in self._managed and \
+                    obj.get("status", {}).get("phase") == "Pending":
+                asyncio.ensure_future(self._mark_running(namespaced_name(obj)))
+
+        self.pod_informer.add_event_handler(ResourceEventHandler(
+            on_add=on_pod, on_update=lambda o, n: on_pod(n)))
+
+    async def register_nodes(self) -> None:
+        for i in range(self.node_count):
+            name = f"{self.name_prefix}{i}"
+            node = make_node(name, **self.node_template)
+            node["metadata"].setdefault("annotations", {})[
+                "kwok.x-k8s.io/node"] = "fake"
+            try:
+                await self.store.create("nodes", node)
+            except AlreadyExists:
+                pass
+            self._managed.add(name)
+
+    def start(self) -> None:
+        super().start()
+        self._tasks.append(asyncio.ensure_future(self._lease_loop()))
+
+    async def _lease_loop(self) -> None:
+        """Renew every managed node's Lease (nodelease cadence)."""
+        while not self._stopped:
+            for name in self._managed:
+                try:
+                    await self.store.guaranteed_update(
+                        "leases", f"kube-node-lease/{name}",
+                        self._renew)
+                except NotFound:
+                    lease = new_object("Lease", name, "kube-node-lease",
+                                       spec={"renewTime": 0})
+                    try:
+                        await self.store.create("leases", lease)
+                    except StoreError:
+                        pass
+                except StoreError:
+                    pass
+            await asyncio.sleep(self.lease_period)
+
+    @staticmethod
+    def _renew(lease: dict) -> dict:
+        lease.setdefault("spec", {})
+        lease["spec"]["renewTime"] = lease["spec"].get("renewTime", 0) + 1
+        return lease
+
+    async def _mark_running(self, key: str) -> None:
+        def mutate(pod):
+            if pod.get("status", {}).get("phase") != "Pending":
+                return None
+            pod.setdefault("status", {})["phase"] = "Running"
+            conds = pod["status"].setdefault("conditions", [])
+            if not any(c.get("type") == "Ready" for c in conds):
+                conds.append({"type": "Ready", "status": "True"})
+            return pod
+        try:
+            await self.store.guaranteed_update("pods", key, mutate)
+        except StoreError:
+            pass
+
+    def fail_node(self, name: str) -> None:
+        """Fault injection: stop heartbeating one node (SURVEY §5.3 —
+        node-death injection is first-class in the sim harness)."""
+        self._managed.discard(name)
+
+    async def sync(self, key: str) -> None:
+        return

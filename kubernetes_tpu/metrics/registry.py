@@ -76,21 +76,6 @@ class Histogram:
             self._sums[key] += value
             self._totals[key] += 1
 
-    def percentile(self, q: float, **labels: str) -> float:
-        """Approximate percentile from bucket counts (for reports/bench)."""
-        key = tuple(labels.get(n, "") for n in self.label_names)
-        counts = self._counts.get(key)
-        total = self._totals.get(key, 0)
-        if not counts or total == 0:
-            return math.nan
-        rank = q * total
-        cum = 0
-        for i, c in enumerate(counts):
-            cum += c if i == 0 else (counts[i] - counts[i - 1])
-            if cum >= rank:
-                return self.buckets[i]
-        return self.buckets[-1]
-
     def snapshot(self, **labels: str) -> tuple[list[int], int]:
         """(bucket counts, total) at this instant — pair with
         percentile_since for windowed percentiles (bench measured phase)."""
@@ -98,21 +83,27 @@ class Histogram:
         return list(self._counts.get(key) or [0] * len(self.buckets)), \
             self._totals.get(key, 0)
 
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket counts (for reports/bench)."""
+        return self.percentile_since(
+            q, ([0] * len(self.buckets), 0), **labels)
+
     def percentile_since(self, q: float, base: tuple[list[int], int],
                          **labels: str) -> float:
-        """Percentile over observations made after `base = snapshot()`."""
+        """Percentile over observations made after `base = snapshot()`.
+
+        Bucket counts are cumulative (observe() increments every bucket
+        ≥ value), so the first bucket whose delta reaches the rank is the
+        answer directly."""
         key = tuple(labels.get(n, "") for n in self.label_names)
         counts = self._counts.get(key)
         base_counts, base_total = base
         total = self._totals.get(key, 0) - base_total
         if not counts or total <= 0:
             return math.nan
-        delta = [c - b for c, b in zip(counts, base_counts)]
         rank = q * total
-        cum = 0
-        for i in range(len(delta)):
-            cum += delta[i] if i == 0 else (delta[i] - delta[i - 1])
-            if cum >= rank:
+        for i, (c, b) in enumerate(zip(counts, base_counts)):
+            if c - b >= rank:
                 return self.buckets[i]
         return self.buckets[-1]
 

@@ -432,8 +432,7 @@ class TPUBackend:
                 diagnostics[pi.key] = {ni.name: contention}
                 continue
             # Stateful plugins must see earlier batch placements.
-            if stateful_batch or i in stateful_pods \
-                    or pi.has_affinity_constraints or pi.host_ports:
+            if stateful_batch or pi.has_affinity_constraints or pi.host_ports:
                 wsnap = Snapshot(
                     [working.get(n.name, n) for n in snapshot.nodes],
                     snapshot.generation)

@@ -23,10 +23,13 @@ from kubernetes_tpu.scheduler.plugins.noderesources import (
     BalancedAllocation,
     NodeResourcesFit,
 )
+from kubernetes_tpu.scheduler.plugins.coscheduling import Coscheduling
 from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
 
-#: name -> factory(args) (framework/runtime Registry)
+#: name -> factory(args) (framework/runtime Registry). Coscheduling is
+#: registered but not default-enabled (out-of-tree in the reference).
 IN_TREE: dict[str, Callable] = {
+    "Coscheduling": Coscheduling,
     "PrioritySort": PrioritySort,
     "SchedulingGates": SchedulingGates,
     "NodeResourcesFit": NodeResourcesFit,

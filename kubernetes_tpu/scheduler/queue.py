@@ -233,6 +233,15 @@ class SchedulingQueue:
             # Cycles currently in flight may be failing for a reason this
             # event just fixed; mark them so their failure lands in backoff.
             self._moved_while_in_flight.update(self._in_flight)
+            # Gated pods re-run PreEnqueue: a gate can lift on events that
+            # don't touch the pod object itself (e.g. Coscheduling's
+            # minMember gate lifts when a SIBLING pod is created).
+            for key in list(self._gated):
+                pi = self._gated[key]
+                if self.framework.run_pre_enqueue(pi).is_success():
+                    del self._gated[key]
+                    self._push_active(pi)
+                    moved += 1
             for key in list(self._unschedulable):
                 pi, _ = self._unschedulable[key]
                 if not self._hint_says_queue(pi, event):
@@ -306,3 +315,7 @@ class SchedulingQueue:
             "unschedulable": len(self._unschedulable),
             "gated": len(self._gated),
         }
+
+    def has_parked(self) -> bool:
+        """Anything a cluster event could wake (gated or unschedulable)."""
+        return bool(self._gated or self._unschedulable)

@@ -99,6 +99,11 @@ class Scheduler:
                     p.framework = fwk
                     if p.evict is None:
                         p.evict = self._preemption_evict
+            for p in fwk.plugins:
+                # Plugins needing the frameworkHandle analog (Permit
+                # allow/reject — e.g. Coscheduling) get the scheduler.
+                if hasattr(p, "set_scheduler"):
+                    p.set_scheduler(self)
         self.cache = SchedulerCache()
         default_fwk = next(iter(self.profiles.values()))
         self.queue = SchedulingQueue(
@@ -129,6 +134,10 @@ class Scheduler:
         self._informer_factory = factory
         pods = factory.informer("pods")
         nodes = factory.informer("nodes")
+        for fwk in self.profiles.values():
+            for p in fwk.plugins:
+                if hasattr(p, "set_informers"):
+                    p.set_informers(factory)
 
         def on_pod_add(obj):
             pi = PodInfo(obj)
@@ -140,6 +149,13 @@ class Scheduler:
                     self.queue.move_all(ClusterEvent("Pod", "Add")))
             elif self._responsible(pi):
                 asyncio.ensure_future(self.queue.add(pi))
+                # A new PENDING pod can lift gates of other pods (e.g.
+                # Coscheduling's minMember gate counts siblings). Only poke
+                # the queue when something is actually parked — at perf
+                # scale this fires once per created pod.
+                if self.queue.has_parked():
+                    asyncio.ensure_future(
+                        self.queue.move_all(ClusterEvent("Pod", "Add")))
 
         def on_pod_update(old, new):
             pi = PodInfo(new)
@@ -383,6 +399,12 @@ class Scheduler:
             self.cache.forget_pod(pi.key)
             await self._requeue_unschedulable(pi, permit_status)
             return
+        if permit_status.is_wait():
+            # Register the waiter SYNCHRONOUSLY (frameworkImpl stores
+            # waitingPods inside RunPermitPlugins): a sibling's permit may
+            # allow/reject this pod before the async binding cycle starts.
+            self._permit_waiters[pi.key] = \
+                asyncio.get_event_loop().create_future()
         task = asyncio.ensure_future(
             self._binding_cycle(fwk, state, pi, node_name, permit_status, timeout))
         self._binding_tasks.add(task)
@@ -444,8 +466,10 @@ class Scheduler:
 
     async def _wait_on_permit(self, fwk: Framework, pi: PodInfo,
                               timeout: float) -> bool:
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._permit_waiters[pi.key] = fut
+        fut = self._permit_waiters.get(pi.key)
+        if fut is None:
+            fut = asyncio.get_event_loop().create_future()
+            self._permit_waiters[pi.key] = fut
         try:
             return await asyncio.wait_for(fut, timeout if timeout > 0 else None)
         except asyncio.TimeoutError:

@@ -1,0 +1,299 @@
+"""Controller tier: RS/Deployment reconcile, node lifecycle, podgc, kwok."""
+
+import asyncio
+
+from kubernetes_tpu.api.meta import new_object
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    DeploymentController,
+    KwokController,
+    NodeLifecycleController,
+    PodGCController,
+    ReplicaSetController,
+    make_deployment,
+    make_replicaset,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(predicate, timeout=8.0, interval=0.03):
+    for _ in range(int(timeout / interval)):
+        v = await predicate()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return await predicate()
+
+
+POD_TEMPLATE = {
+    "metadata": {"labels": {"app": "web"}},
+    "spec": {"containers": [{"name": "main", "image": "web:v1",
+                             "resources": {"requests": {"cpu": "100m"}}}]},
+}
+
+
+class TestReplicaSet:
+    def test_scales_up_and_down(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            rsc = ReplicaSetController(store)
+            mgr = ControllerManager(store, [rsc])
+            await mgr.start()
+            rs = make_replicaset("web", 5, {"matchLabels": {"app": "web"}},
+                                 POD_TEMPLATE)
+            await store.create("replicasets", rs)
+
+            async def count():
+                pods = (await store.list("pods")).items
+                return len(pods) == 5 and pods
+            assert await wait_for(count)
+
+            # Scale down to 2.
+            await store.guaranteed_update(
+                "replicasets", "default/web",
+                lambda o: (o["spec"].__setitem__("replicas", 2), o)[1])
+
+            async def count2():
+                return len((await store.list("pods")).items) == 2
+            assert await wait_for(count2)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+    def test_replaces_deleted_pod(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = ControllerManager(store, [ReplicaSetController(store)])
+            await mgr.start()
+            await store.create("replicasets", make_replicaset(
+                "web", 3, {"matchLabels": {"app": "web"}}, POD_TEMPLATE))
+
+            async def three():
+                items = (await store.list("pods")).items
+                return items if len(items) == 3 else None
+            pods = await wait_for(three)
+            assert pods
+            victim = pods[0]["metadata"]["name"]
+            await store.delete("pods", f"default/{victim}")
+
+            async def replaced():
+                items = (await store.list("pods")).items
+                return len(items) == 3 and all(
+                    p["metadata"]["name"] != victim for p in items)
+            assert await wait_for(replaced)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+
+class TestDeployment:
+    def test_creates_rs_and_pods(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = ControllerManager(store, [
+                DeploymentController(store), ReplicaSetController(store)])
+            await mgr.start()
+            await store.create("deployments", make_deployment(
+                "web", 4, {"matchLabels": {"app": "web"}}, POD_TEMPLATE))
+
+            async def ready():
+                rses = (await store.list("replicasets")).items
+                pods = (await store.list("pods")).items
+                return len(rses) == 1 and len(pods) == 4
+            assert await wait_for(ready)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+    def test_rolling_update_replaces_revision(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = ControllerManager(store, [
+                DeploymentController(store), ReplicaSetController(store)])
+            await mgr.start()
+            await store.create("deployments", make_deployment(
+                "web", 3, {"matchLabels": {"app": "web"}}, POD_TEMPLATE))
+
+            async def v1_up():
+                pods = (await store.list("pods")).items
+                return len(pods) == 3
+            assert await wait_for(v1_up)
+            # Fake kubelet: mark pods bound/ready so the rollout can judge
+            # availability (readyReplicas counts nodeName).
+            for p in (await store.list("pods")).items:
+                key = f"default/{p['metadata']['name']}"
+                await store.guaranteed_update(
+                    "pods", key,
+                    lambda o: (o["spec"].__setitem__("nodeName", "n1"), o)[1])
+
+            # New template revision.
+            def bump(dep):
+                dep["spec"]["template"]["spec"]["containers"][0]["image"] = "web:v2"
+                return dep
+            await store.guaranteed_update("deployments", "default/web", bump)
+
+            async def rolled():
+                pods = (await store.list("pods")).items
+                images = {p["spec"]["containers"][0]["image"] for p in pods}
+                # keep nodeName on new pods so availability advances
+                for p in pods:
+                    if not p["spec"].get("nodeName"):
+                        key = f"default/{p['metadata']['name']}"
+                        try:
+                            await_ = store.guaranteed_update(
+                                "pods", key,
+                                lambda o: (o["spec"].__setitem__(
+                                    "nodeName", "n1"), o)[1])
+                            await await_
+                        except Exception:
+                            pass
+                return images == {"web:v2"} and len(pods) == 3
+            assert await wait_for(rolled, timeout=12.0)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+
+class TestNodeLifecycle:
+    def test_stale_node_tainted_and_pods_evicted(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            nlc = NodeLifecycleController(
+                store, node_monitor_period=0.05,
+                node_monitor_grace_period=0.2,
+                default_toleration_seconds=0.1)
+            mgr = ControllerManager(store, [nlc])
+            await store.create("nodes", make_node("n1"))
+            # Admission injects the default 300s unreachable toleration
+            # (defaulttolerationseconds); pin a short one for the test.
+            await store.create("pods", make_pod(
+                "p1", node_name="n1", tolerations=[
+                    {"key": "node.kubernetes.io/unreachable",
+                     "operator": "Exists", "effect": "NoExecute",
+                     "tolerationSeconds": 0.1},
+                    {"key": "node.kubernetes.io/not-ready",
+                     "operator": "Exists", "effect": "NoExecute",
+                     "tolerationSeconds": 0.1}]))
+            await mgr.start()
+
+            async def tainted():
+                n = await store.get("nodes", "n1")
+                return any(t["key"] == "node.kubernetes.io/unreachable"
+                           for t in n["spec"].get("taints") or [])
+            assert await wait_for(tainted)
+
+            async def evicted():
+                pods = (await store.list("pods")).items
+                return not pods
+            assert await wait_for(evicted)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+    def test_heartbeat_prevents_taint_and_recovery_untaints(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            nlc = NodeLifecycleController(
+                store, node_monitor_period=0.05,
+                node_monitor_grace_period=0.3)
+            kwok = KwokController(store, node_count=1, lease_period=0.05)
+            mgr = ControllerManager(store, [nlc, kwok])
+            await kwok.register_nodes()
+            await mgr.start()
+            await asyncio.sleep(0.6)
+            n = await store.get("nodes", "kwok-node-0")
+            assert not (n["spec"].get("taints") or []), "live node got tainted"
+
+            # Kill heartbeats → taint appears; resume → taint removed.
+            kwok.fail_node("kwok-node-0")
+
+            async def tainted():
+                nn = await store.get("nodes", "kwok-node-0")
+                return any(t["key"] == "node.kubernetes.io/unreachable"
+                           for t in nn["spec"].get("taints") or [])
+            assert await wait_for(tainted)
+            kwok._managed.add("kwok-node-0")
+
+            async def untainted():
+                nn = await store.get("nodes", "kwok-node-0")
+                return not any(
+                    t["key"] == "node.kubernetes.io/unreachable"
+                    for t in nn["spec"].get("taints") or [])
+            assert await wait_for(untainted)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+
+class TestPodGC:
+    def test_orphans_collected(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            gc = PodGCController(store, gc_period=0.05)
+            mgr = ControllerManager(store, [gc])
+            await store.create("nodes", make_node("n1"))
+            await store.create("pods", make_pod("good", node_name="n1"))
+            await store.create("pods", make_pod("orphan", node_name="ghost"))
+            await mgr.start()
+
+            async def collected():
+                names = {p["metadata"]["name"]
+                         for p in (await store.list("pods")).items}
+                return names == {"good"}
+            assert await wait_for(collected)
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+
+class TestKwokE2E:
+    def test_full_chain_deployment_to_running_pods(self):
+        """Deployment → RS → pods → scheduler → kwok marks Running: the
+        whole control plane with zero kubelets."""
+        async def body():
+            from kubernetes_tpu.client import InformerFactory
+            from kubernetes_tpu.scheduler import Scheduler
+
+            store = new_cluster_store()
+            install_core_validation(store)
+            kwok = KwokController(store, node_count=5, lease_period=0.2)
+            await kwok.register_nodes()
+            mgr = ControllerManager(store, [
+                DeploymentController(store), ReplicaSetController(store),
+                kwok])
+            await mgr.start()
+            sched = Scheduler(store, seed=3)
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            sched_task = asyncio.ensure_future(sched.run())
+
+            await store.create("deployments", make_deployment(
+                "web", 6, {"matchLabels": {"app": "web"}}, POD_TEMPLATE))
+
+            async def running():
+                pods = (await store.list("pods")).items
+                return len(pods) == 6 and all(
+                    p["status"].get("phase") == "Running"
+                    and p["spec"].get("nodeName", "").startswith("kwok-node-")
+                    for p in pods)
+            assert await wait_for(running, timeout=10.0)
+            await sched.stop()
+            sched_task.cancel()
+            await mgr.stop()
+            factory.stop()
+            store.stop()
+        run(body())

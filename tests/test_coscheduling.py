@@ -1,0 +1,134 @@
+"""Coscheduling (PodGroup gang scheduling) — BASELINE config #3."""
+
+import asyncio
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.metrics.registry import SchedulerMetrics
+from kubernetes_tpu.ops import TPUBackend
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.framework import Framework
+from kubernetes_tpu.scheduler.plugins.coscheduling import (
+    POD_GROUP_LABEL,
+    make_pod_group,
+)
+from kubernetes_tpu.scheduler.plugins.registry import (
+    DEFAULT_PLUGINS,
+    DEFAULT_SCORE_WEIGHTS,
+    build_plugins,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def gang_pod(name, group, cpu="500m", uid=None):
+    return make_pod(name, labels={POD_GROUP_LABEL: group},
+                    requests={"cpu": cpu}, uid=uid or name)
+
+
+async def make_sched(store, backend=None):
+    plugins = build_plugins(DEFAULT_PLUGINS + ["Coscheduling"], store=store)
+    fwk = Framework(plugins, DEFAULT_SCORE_WEIGHTS,
+                    metrics=SchedulerMetrics())
+    sched = Scheduler(store, profiles={"default-scheduler": fwk},
+                      seed=7, backend=backend)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    return sched, factory
+
+
+async def bound_names(store):
+    return {p["metadata"]["name"]
+            for p in (await store.list("pods")).items
+            if p["spec"].get("nodeName")}
+
+
+class TestGangScheduling:
+    def test_gang_waits_then_binds_together(self, backend=None):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(4):
+                await store.create("nodes", make_node(f"n{i}"))
+            await store.create("podgroups", make_pod_group(
+                "job1", min_member=3, schedule_timeout_seconds=5.0))
+            sched, factory = await make_sched(store, backend=backend)
+            task = asyncio.ensure_future(sched.run(
+                batch_size=8 if backend else 1))
+
+            # Two members: gang can't assemble; PreEnqueue gates them.
+            await store.create("pods", gang_pod("g-0", "job1"))
+            await store.create("pods", gang_pod("g-1", "job1"))
+            await asyncio.sleep(0.4)
+            assert await bound_names(store) == set()
+
+            # Third member arrives → gate lifts → all three bind.
+            await store.create("pods", gang_pod("g-2", "job1"))
+            for _ in range(150):
+                if len(await bound_names(store)) == 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert await bound_names(store) == {"g-0", "g-1", "g-2"}
+            await sched.stop()
+            task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_gang_with_tpu_backend(self):
+        self.test_gang_waits_then_binds_together(backend=TPUBackend(max_batch=8))
+
+    def test_incomplete_gang_times_out_and_releases_resources(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            # One node, 8 cores: gang of 3×3 cores can never fully assemble
+            # feasibly (only 2 fit) — waiters must time out and release.
+            await store.create("nodes", make_node(
+                "n0", allocatable={"cpu": "8", "memory": "32Gi",
+                                   "pods": "110"}))
+            await store.create("podgroups", make_pod_group(
+                "big", min_member=3, schedule_timeout_seconds=0.5))
+            sched, factory = await make_sched(store)
+            task = asyncio.ensure_future(sched.run())
+
+            for i in range(3):
+                await store.create("pods", gang_pod(f"b-{i}", "big", cpu="3"))
+            await asyncio.sleep(1.5)
+            # Nothing durably bound (two waiters timed out, their assumes
+            # were forgotten; the whole gang remains pending).
+            assert await bound_names(store) == set()
+            # A normal pod can still use the node's full capacity.
+            await store.create("pods", make_pod(
+                "solo", requests={"cpu": "6"}, uid="solo"))
+            for _ in range(100):
+                if "solo" in await bound_names(store):
+                    break
+                await asyncio.sleep(0.05)
+            assert "solo" in await bound_names(store)
+            await sched.stop()
+            task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_missing_pod_group_is_unresolvable(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            await store.create("nodes", make_node("n0"))
+            sched, factory = await make_sched(store)
+            task = asyncio.ensure_future(sched.run())
+            await store.create("pods", gang_pod("lost", "nogroup"))
+            await asyncio.sleep(0.4)
+            assert await bound_names(store) == set()
+            await sched.stop()
+            task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
