@@ -36,7 +36,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", choices=PRESETS, default="5k")
     ap.add_argument("--backend", choices=["host", "tpu"], default="tpu")
-    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=512)
     args = ap.parse_args(argv)
 
     from kubernetes_tpu.perf.scheduler_perf import PerfRunner

@@ -1,0 +1,268 @@
+"""Tensorized InterPodAffinity filter (BASELINE config #2 hot path).
+
+Replaces the host plugin's O(pods × nodes × terms) Python walk
+(pkg/scheduler/framework/plugins/interpodaffinity/filtering.go — "the
+classic hot spot", SURVEY §2.3) with dense algebra over interned label
+signatures (ops/labelsets.py):
+
+    counts_t (N,)  = node_sig_count @ match_vec(term)        # matvec
+    D_t (K,)       = segment_sum(counts_t · has_key, domains) # per-domain
+    per_node (N,)  = D_t[domain_ids]                          # gather
+    anti mask      = ¬has_key ∨ (per_node == 0)
+    affinity mask  = has_key ∧ (per_node > 0)   [+ first-pod-in-group rule]
+    symmetry mask  = ¬has_key ∨ (forbidden-domain count == 0), applied to
+                     pods the resident term matches
+
+Numpy, deliberately: U (label signatures) and T (unique terms) are tiny for
+template-derived workloads, so per-term cost is a (N×U) matvec — far below
+one device dispatch. The resulting (P,N) mask feeds the XLA solver; parity
+with the host plugin is differential-tested (tests/test_affinity_tensor.py).
+
+Unsupported shape → per-pod host fallback (namespaceSelector in terms; the
+host plugin models the nil case only, same as us — kept symmetrical).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetes_tpu.ops.labelsets import LabelSigTable, TopologyTable
+from kubernetes_tpu.scheduler.types import PodInfo, Snapshot
+
+
+def _seg_sum(values: np.ndarray, ids: np.ndarray, num: int) -> np.ndarray:
+    out = np.zeros((num,), dtype=values.dtype)
+    np.add.at(out, ids, values)
+    return out
+
+
+def _term_ns(term: dict, owner_ns: str) -> tuple[str, ...]:
+    return tuple(term.get("namespaces") or [owner_ns])
+
+
+class AffinityCompiler:
+    """Per-snapshot compiled state for batched affinity filtering."""
+
+    def __init__(self, snapshot: Snapshot, n_pad: int):
+        self.snapshot = snapshot
+        self.n_pad = n_pad
+        self.n_real = len(snapshot.nodes)
+        self.sigs = LabelSigTable(snapshot, n_pad)
+        self.topo = TopologyTable(snapshot.nodes, n_pad)
+        # Resident pods' required anti-affinity terms (symmetry source):
+        # term signature -> (carrier-count vector over nodes, term, owner_ns).
+        self.resident_anti: dict[str, tuple[np.ndarray, dict, str]] = {}
+        for n, ni in enumerate(snapshot.nodes):
+            if not ni.pods_with_required_anti_affinity:
+                continue
+            for pi in ni.pods_with_required_anti_affinity:
+                for term in pi.required_anti_affinity_terms:
+                    key = repr((term, pi.namespace))
+                    got = self.resident_anti.get(key)
+                    if got is None:
+                        vec = np.zeros((n_pad,), dtype=np.float32)
+                        self.resident_anti[key] = (vec, term, pi.namespace)
+                        got = self.resident_anti[key]
+                    got[0][n] += 1.0
+        #: per-pending-pod-signature symmetry-match cache
+        self._sym_match_cache: dict[tuple, bool] = {}
+        #: per-(term,ns) per-node matching-count cache
+        self._count_cache: dict[str, np.ndarray] = {}
+        #: per-term-signature compiled masks
+        self._mask_cache: dict[str, np.ndarray] = {}
+
+    # -- primitives --------------------------------------------------------
+
+    def counts_for(self, selector: dict | None,
+                   namespaces: tuple[str, ...]) -> np.ndarray:
+        """(n_pad,) count of resident pods matching selector per node."""
+        key = repr((selector, namespaces))
+        c = self._count_cache.get(key)
+        if c is None:
+            c = self.sigs.node_sig_count @ self.sigs.match_vec(
+                selector, namespaces)
+            self._count_cache[key] = c
+        return c
+
+    def _domain_presence(self, counts: np.ndarray,
+                         topology_key: str) -> tuple[np.ndarray, np.ndarray]:
+        """(per_node_domain_count (n_pad,), has_key (n_pad,))."""
+        dom_ids, num = self.topo.domains(topology_key)
+        has_key = dom_ids > 0
+        d = _seg_sum(np.where(has_key, counts, 0.0), dom_ids, num)
+        d[0] = 0.0
+        return d[dom_ids], has_key
+
+    # -- per-term masks (cached by term signature) -------------------------
+
+    @staticmethod
+    def supported(pod: PodInfo) -> bool:
+        terms = (pod.required_affinity_terms
+                 + pod.required_anti_affinity_terms)
+        return not any(t.get("namespaceSelector") for t in terms)
+
+    def anti_term_mask(self, term: dict, owner_ns: str) -> np.ndarray:
+        key = "anti/" + repr((term, owner_ns))
+        m = self._mask_cache.get(key)
+        if m is None:
+            counts = self.counts_for(term.get("labelSelector"),
+                                     _term_ns(term, owner_ns))
+            per_node, has_key = self._domain_presence(
+                counts, term.get("topologyKey", ""))
+            m = ~has_key | (per_node == 0)
+            self._mask_cache[key] = m
+        return m
+
+    def affinity_term_presence(self, term: dict,
+                               owner_ns: str) -> tuple[np.ndarray, np.ndarray, float]:
+        """(per_node matching count, has_key, total matches anywhere)."""
+        key = "aff/" + repr((term, owner_ns))
+        got = self._mask_cache.get(key)
+        if got is None:
+            counts = self.counts_for(term.get("labelSelector"),
+                                     _term_ns(term, owner_ns))
+            tk = term.get("topologyKey", "")
+            per_node, has_key = self._domain_presence(counts, tk)
+            # `total` drives the first-pod-in-group escape: the host plugin
+            # only counts matches on nodes that HAVE the topology key
+            # (pre_filter skips tv-None nodes), so mask accordingly.
+            total = float(np.sum(np.where(
+                has_key[: self.n_real], counts[: self.n_real], 0.0)))
+            got = (per_node, has_key, total)
+            self._mask_cache[key] = got
+        return got
+
+    def symmetry_mask(self, pod: PodInfo) -> np.ndarray:
+        """Nodes forbidden to `pod` by resident pods' required anti-affinity
+        (the both-ways check in filtering.go)."""
+        mask = np.ones((self.n_pad,), dtype=np.bool_)
+        if not self.resident_anti:
+            return mask
+        from kubernetes_tpu.api.labels import from_label_selector
+        pod_sig = (pod.namespace, tuple(sorted(pod.labels.items())))
+        for key, (carriers, term, owner_ns) in self.resident_anti.items():
+            mk = (key, pod_sig)
+            hit = self._sym_match_cache.get(mk)
+            if hit is None:
+                nses = _term_ns(term, owner_ns)
+                hit = pod.namespace in nses and from_label_selector(
+                    term.get("labelSelector")).matches(pod.labels)
+                self._sym_match_cache[mk] = hit
+            if not hit:
+                continue
+            skey = "sym/" + key
+            m = self._mask_cache.get(skey)
+            if m is None:
+                per_node, has_key = self._domain_presence(
+                    carriers, term.get("topologyKey", ""))
+                m = ~has_key | (per_node == 0)
+                self._mask_cache[skey] = m
+            mask &= m
+        return mask
+
+    # -- the batch entry ----------------------------------------------------
+
+    def filter_row(self, pod: PodInfo) -> np.ndarray:
+        """(n_pad,) bool feasibility row for one pending pod — exact
+        InterPodAffinity.Filter semantics over the snapshot."""
+        row = self.symmetry_mask(pod).copy()
+        for term in pod.required_anti_affinity_terms:
+            row &= self.anti_term_mask(term, pod.namespace)
+        if pod.required_affinity_terms:
+            # first-pod-in-group rule: if NO term matches anything anywhere
+            # and the pod matches its own terms, terms don't reject (nodes
+            # still need the topology keys).
+            presences = [
+                self.affinity_term_presence(t, pod.namespace)
+                for t in pod.required_affinity_terms]
+            total_any = sum(p[2] for p in presences)
+            if total_any == 0 and self._self_matches(pod):
+                for _, has_key, _ in presences:
+                    row &= has_key
+            else:
+                for per_node, has_key, _ in presences:
+                    row &= has_key & (per_node > 0)
+        row[self.n_real:] = False
+        return row
+
+    def _self_matches(self, pod: PodInfo) -> bool:
+        from kubernetes_tpu.api.labels import from_label_selector
+        for t in pod.required_affinity_terms:
+            if pod.namespace not in _term_ns(t, pod.namespace):
+                return False
+            if not from_label_selector(t.get("labelSelector")).matches(pod.labels):
+                return False
+        return True
+
+    # -- PodTopologySpread (same primitives, skew semantics) ---------------
+
+    def eligibility_row(self, pod: PodInfo) -> np.ndarray:
+        """(n_pad,) nodes eligible for domain counting under this pod's
+        nodeSelector/affinity/tolerations (podtopologyspread._node_eligible),
+        cached by the pod's eligibility signature."""
+        key = "elig/" + repr((pod.node_selector,
+                              pod.affinity.get("nodeAffinity"),
+                              pod.tolerations))
+        row = self._mask_cache.get(key)
+        if row is None:
+            from kubernetes_tpu.scheduler.plugins.podtopologyspread import (
+                _node_eligible,
+            )
+            row = np.zeros((self.n_pad,), dtype=np.bool_)
+            for n, ni in enumerate(self.snapshot.nodes):
+                row[n] = _node_eligible(pod, ni)
+            self._mask_cache[key] = row
+        return row
+
+    def _spread_domain_counts(self, pod: PodInfo, constraint: dict):
+        """Per-constraint: (per_node_count, has_key, eligible, min_count).
+
+        Host semantics (_build_state): only eligible nodes' pods count and
+        only eligible domains exist; min is over eligible domains."""
+        key = "spread/" + repr((constraint, pod.namespace,
+                                pod.node_selector,
+                                pod.affinity.get("nodeAffinity"),
+                                pod.tolerations))
+        got = self._mask_cache.get(key)
+        if got is None:
+            sel = constraint.get("labelSelector")
+            counts = self.counts_for(sel, (pod.namespace,))
+            elig = self.eligibility_row(pod)
+            tk = constraint["topologyKey"]
+            dom_ids, num = self.topo.domains(tk)
+            has_key = dom_ids > 0
+            active = has_key & elig
+            d = _seg_sum(np.where(active, counts, 0.0), dom_ids, num)
+            # Domains with at least one eligible node "exist" (count ≥ 0);
+            # others are fresh (None in the host dict → constraint passes).
+            exists = _seg_sum(active.astype(np.float32), dom_ids, num) > 0
+            exists[0] = False
+            mins = d[exists] if exists.any() else None
+            min_count = float(mins.min()) if mins is not None else 0.0
+            got = (d[dom_ids], has_key, exists[dom_ids], min_count)
+            self._mask_cache[key] = got
+        return got
+
+    def spread_filter_row(self, pod: PodInfo,
+                          constraints: list[dict]) -> np.ndarray:
+        """(n_pad,) DoNotSchedule skew feasibility
+        (podtopologyspread.filter)."""
+        row = np.ones((self.n_pad,), dtype=np.bool_)
+        for c in constraints:
+            per_node, has_key, exists, min_count = \
+                self._spread_domain_counts(pod, c)
+            max_skew = c.get("maxSkew", 1)
+            ok = (~exists) | (per_node + 1 - min_count <= max_skew)
+            row &= has_key & ok
+        row[self.n_real:] = False
+        return row
+
+    def spread_raw_scores(self, pod: PodInfo,
+                          constraints: list[dict]) -> np.ndarray:
+        """(n_pad,) raw ScheduleAnyway score: Σ matching-pod count in the
+        node's domains (podtopologyspread.score; NormalizeScore inverts)."""
+        raw = np.zeros((self.n_pad,), dtype=np.float32)
+        for c in constraints:
+            per_node, has_key, _, _ = self._spread_domain_counts(pod, c)
+            raw += np.where(has_key, per_node, 0.0)
+        return raw

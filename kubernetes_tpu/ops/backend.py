@@ -150,6 +150,15 @@ class TPUBackend:
         # (plugin, sig) -> np row; valid while _row_fp matches.
         self._row_cache: dict[tuple[str, str], np.ndarray] = {}
         self._row_fp: tuple | None = None
+        # Device-resident constants for the common "no host rows" case:
+        # uploading a (P,N) bool+f32 pair every batch (~6.5 MB at 5k nodes)
+        # dominates wall-clock on a remote-attached TPU. Keyed by shape.
+        self._dev_base_mask: dict[tuple, object] = {}
+        self._dev_zero_scores: dict[tuple, object] = {}
+        # Static per-snapshot arrays (alloc, taints) re-uploaded only when
+        # the node-static fingerprint moves.
+        self._dev_static: dict[str, object] = {}
+        self._dev_static_fp: tuple | None = None
 
     # -- snapshot compilation ----------------------------------------------
 
@@ -157,10 +166,34 @@ class TPUBackend:
         if self._ct is None or self._ct.generation != snapshot.generation:
             self._ct = ClusterTensors(
                 snapshot, resources=self._pinned_resources, prev=self._ct)
+            self._affinity = None  # resident pods changed → recompile
         if self._row_fp != self._ct._static_fp:
             self._row_cache.clear()
             self._row_fp = self._ct._static_fp
         return self._ct
+
+    def _affinity_compiler(self, snapshot: Snapshot, ct: ClusterTensors):
+        if getattr(self, "_affinity", None) is None:
+            from kubernetes_tpu.ops.affinity import AffinityCompiler
+            self._affinity = AffinityCompiler(snapshot, ct.n_pad)
+        return self._affinity
+
+    def _ipa_score_relevant(self, pi: PodInfo, snapshot: Snapshot) -> bool:
+        """InterPodAffinity Score is nonzero only if the pod has preferred
+        terms, or some resident pod contributes symmetry weight (preferred
+        terms, or required affinity terms × hardPodAffinityWeight)."""
+        if pi.preferred_affinity_terms or pi.preferred_anti_affinity_terms:
+            return True
+        cached = getattr(self, "_ipa_resident_relevant", None)
+        if cached is not None and cached[0] == snapshot.generation:
+            return cached[1]
+        relevant = any(
+            e.preferred_affinity_terms or e.preferred_anti_affinity_terms
+            or e.required_affinity_terms
+            for ni in snapshot.have_pods_with_affinity
+            for e in ni.pods_with_affinity)
+        self._ipa_resident_relevant = (snapshot.generation, relevant)
+        return relevant
 
     # -- host rows -----------------------------------------------------------
 
@@ -225,9 +258,16 @@ class TPUBackend:
         filter_names = {p.NAME for p in fwk.filter_plugins}
         score_plugins = {p.NAME: p for p in fwk.score_plugins}
 
-        # Base mask: real pods × valid nodes.
+        # Base mask: real pods × valid nodes. Tracked copy-on-write so the
+        # unmodified case can reuse a cached device array (no re-upload).
+        base_key = (P, N, batch.p_real, ct.n_real)
         static_mask = np.zeros((P, N), dtype=np.bool_)
         static_mask[: batch.p_real, : ct.n_real] = True
+        mask_modified = False
+
+        def _mark_mask_modified():
+            nonlocal mask_modified
+            mask_modified = True
 
         # Pods requesting resources no tracked column covers are infeasible
         # everywhere (would silently drop a constraint on device).
@@ -236,13 +276,16 @@ class TPUBackend:
             if ct.has_unknown_resource(pi.requests):
                 static_mask[i, :] = False
                 unknown_res.add(i)
+                _mark_mask_modified()
 
         # Host-side rows: static predicate plugins (signature-cached) and
         # stateful irregular plugins (per pod, Skip-gated).
         dyn_states: dict[int, CycleState] = {}
         host_filter_fail: dict[str, np.ndarray] = {}  # plugin -> (P,N) ok-mask
-        #: pods whose dynamic-plugin filter gate fired (need post-solve
-        #: re-verification against earlier batch placements).
+        #: pods whose NON-affinity stateful filter gate fired (full host
+        #: re-verification). Affinity-handled pods are covered by the cheap
+        #: delta verify inside _verify (routed by delta_has_terms /
+        #: has_affinity_constraints), not by this set.
         stateful_pods: set[int] = set()
 
         def apply_row(pname: str, i: int, row: np.ndarray) -> None:
@@ -251,6 +294,7 @@ class TPUBackend:
                 ok = host_filter_fail[pname] = np.ones((P, N), dtype=np.bool_)
             ok[i, : ct.n_real] &= row
             static_mask[i, : ct.n_real] &= row
+            _mark_mask_modified()
 
         for plugin in fwk.filter_plugins:
             if plugin.NAME in DEVICE_FILTER_PLUGINS:
@@ -268,6 +312,30 @@ class TPUBackend:
                         continue
                     if gate is not None and not gate(plugin, pi, snapshot):
                         continue
+                    if plugin.NAME == "InterPodAffinity":
+                        # Tensorized path (ops/affinity.py): dense per-term
+                        # masks over interned label signatures instead of
+                        # O(N) host plugin calls per pod.
+                        compiler = self._affinity_compiler(snapshot, ct)
+                        if compiler.supported(pi):
+                            row = compiler.filter_row(pi)[: ct.n_real]
+                            if not row.all():
+                                apply_row(plugin.NAME, i, row)
+                            continue
+                    if plugin.NAME == "PodTopologySpread":
+                        constraints = plugin._constraints_for(
+                            pi, "DoNotSchedule")
+                        if not constraints:
+                            continue  # gate was conservative; nothing to do
+                        if not any(c.get("namespaceSelector")
+                                   for c in constraints):
+                            compiler = self._affinity_compiler(snapshot, ct)
+                            row = compiler.spread_filter_row(
+                                pi, constraints)[: ct.n_real]
+                            if not row.all():
+                                apply_row(plugin.NAME, i, row)
+                            stateful_pods.add(i)
+                            continue
                     state = dyn_states.setdefault(i, CycleState())
                     row = self._dynamic_filter_row(plugin, pi, snapshot, ct, state)
                     if row is not None:
@@ -286,6 +354,7 @@ class TPUBackend:
         # exact fit — or min-max normalizations get skewed by scores of
         # nodes the solver will mask anyway.
         host_scores = np.zeros((P, N), dtype=np.float32)
+        scores_modified = False
         fit_np: np.ndarray | None = None
         taint_np: np.ndarray | None = None
 
@@ -324,6 +393,33 @@ class TPUBackend:
                     gate = _SCORE_ACTIVE.get(name)
                     if gate is not None and not gate(plugin, pi, snapshot):
                         continue
+                    if name == "PodTopologySpread":
+                        # Tensorized raw counts + vectorized NormalizeScore
+                        # (min-max inversion over the feasible set).
+                        constraints = plugin._constraints_for(
+                            pi, "ScheduleAnyway")
+                        if not any(c.get("namespaceSelector")
+                                   for c in constraints):
+                            compiler = self._affinity_compiler(snapshot, ct)
+                            raw_row = compiler.spread_raw_scores(
+                                pi, constraints)[: ct.n_real]
+                            feas = feasible_idx(i)
+                            if feas.size:
+                                vals = raw_row[feas]
+                                mx, mn = vals.max(), vals.min()
+                                if mx > mn:
+                                    norm = 100.0 * (mx - vals) / (mx - mn)
+                                else:
+                                    norm = np.full_like(vals, 100.0)
+                                host_scores[i, feas] += w * norm
+                                scores_modified = True
+                            continue
+                    if name == "InterPodAffinity" and \
+                            not self._ipa_score_relevant(pi, snapshot):
+                        # No preferred terms anywhere and no hard-affinity
+                        # symmetry sources → every score is 0; skip the
+                        # O(N × residents) walk that would prove it.
+                        continue
                     state = dyn_states.setdefault(i, CycleState())
                     nodes_i = [snapshot.nodes[j] for j in feasible_idx(i)]
                     st = plugin.pre_score(state, pi, nodes_i)
@@ -335,6 +431,7 @@ class TPUBackend:
                 plugin.normalize_scores(state, pi, raw)
                 for nname, s in raw.items():
                     host_scores[i, ct.name_to_idx[nname]] += w * s
+                scores_modified = True
 
         # Device pass.
         fit_plugin = score_plugins.get("NodeResourcesFit")
@@ -357,15 +454,43 @@ class TPUBackend:
         shape_u = np.array([p["utilization"] for p in shape_pts], np.float32)
         shape_s = np.array([p["score"] for p in shape_pts], np.float32)
 
+        # Reuse device-resident constants when untouched (remote-TPU upload
+        # bandwidth is the bottleneck at 5k nodes).
+        if mask_modified:
+            dev_mask = jnp.asarray(static_mask)
+        else:
+            dev_mask = self._dev_base_mask.get(base_key)
+            if dev_mask is None:
+                dev_mask = self._dev_base_mask[base_key] = \
+                    jnp.asarray(static_mask)
+        if scores_modified:
+            dev_scores = jnp.asarray(host_scores)
+        else:
+            dev_scores = self._dev_zero_scores.get((P, N))
+            if dev_scores is None:
+                dev_scores = self._dev_zero_scores[(P, N)] = \
+                    jnp.asarray(host_scores)
+
+        if self._dev_static_fp != ct._static_fp or \
+                self._dev_static.get("alloc_shape") != ct.alloc_q.shape:
+            self._dev_static = {
+                "alloc_q": jnp.asarray(ct.alloc_q),
+                "alloc_pods": jnp.asarray(ct.alloc_pods),
+                "taint_f": jnp.asarray(ct.taint_filter_mat),
+                "taint_p": jnp.asarray(ct.taint_prefer_mat),
+                "alloc_shape": ct.alloc_q.shape,
+            }
+            self._dev_static_fp = ct._static_fp
+
         w = fwk.score_weights
         assign_d, fit0_d, taint_ok_d, feasible_d = _mask_and_solve(
-            jnp.asarray(ct.alloc_q), jnp.asarray(ct.used_q),
-            jnp.asarray(ct.used_nz_q), jnp.asarray(ct.alloc_pods),
+            self._dev_static["alloc_q"], jnp.asarray(ct.used_q),
+            jnp.asarray(ct.used_nz_q), self._dev_static["alloc_pods"],
             jnp.asarray(ct.used_pods),
             jnp.asarray(batch.req_q), jnp.asarray(batch.req_nz_q),
             jnp.asarray(batch.untol_filter), jnp.asarray(batch.untol_prefer),
-            jnp.asarray(ct.taint_filter_mat), jnp.asarray(ct.taint_prefer_mat),
-            jnp.asarray(static_mask), jnp.asarray(host_scores),
+            self._dev_static["taint_f"], self._dev_static["taint_p"],
+            dev_mask, dev_scores,
             jnp.asarray(fit_col_w), jnp.asarray(bal_col_mask),
             jnp.asarray(shape_u), jnp.asarray(shape_s),
             jnp.float32(w.get("NodeResourcesFit", 1) if fit_plugin else 0),
@@ -379,7 +504,8 @@ class TPUBackend:
 
         # Host verify + working-state accumulation (hard part #1).
         assignments, diagnostics = self._verify(
-            pods, assign, snapshot, fwk, ct, stateful_pods)
+            pods, assign, snapshot, fwk, ct, stateful_pods,
+            compiler=getattr(self, "_affinity", None))
 
         # Lazy per-plugin diagnostics for unassigned pods.
         need_diag = [i for i, pi in enumerate(pods)
@@ -394,10 +520,30 @@ class TPUBackend:
 
     # -- verification --------------------------------------------------------
 
-    def _verify(self, pods, assign, snapshot, fwk, ct, stateful_pods):
+    def _verify(self, pods, assign, snapshot, fwk, ct, stateful_pods,
+                compiler=None):
+        """Post-solve verification (hard part #1: solve → verify → requeue).
+
+        The batch-start masks are EXACT w.r.t. the snapshot (host rows use
+        the host plugins; the tensorized affinity rows are differential-
+        tested), so verification only has to account for the *delta* —
+        pods placed earlier in this same batch:
+
+        - resources: exact integer re-check against the working node
+        - inter-pod affinity (incl. symmetry both ways): checked against
+          the delta placements only — O(|delta| × terms), not O(cluster)
+        - host ports: against the working node's accumulated ports
+        - anything else stateful (PodTopologySpread & friends in
+          `stateful_pods`): full host re-check against a working snapshot
+        """
         assignments: dict[str, str | None] = {}
         diagnostics: dict[str, dict[str, Status]] = {}
         working: dict[str, NodeInfo] = {}
+        #: batch placements so far: (PodInfo, node_labels)
+        delta: list[tuple[PodInfo, dict]] = []
+        #: any delta pod carries required anti-affinity or affinity terms
+        delta_has_terms = False
+        sel_cache: dict = {}  # compiled selectors for the delta loops
 
         def node_for(idx: int) -> NodeInfo:
             name = ct.node_names[idx]
@@ -407,17 +553,16 @@ class TPUBackend:
                 working[name] = ni
             return ni
 
-        # If ANY batch pod activated a stateful filter plugin (gate fired —
-        # explicit constraints or profile defaults), later placements can
-        # invalidate earlier host rows, including for pods with no
-        # constraints of their own (anti-affinity symmetry) — so every
-        # placement gets the full plugin re-check against the working
-        # snapshot in that case.
-        stateful_batch = bool(stateful_pods)
-
+        full_check_batch = bool(stateful_pods)
         contention = Status.unschedulable(
             "node(s) exhausted by earlier pods in the batch"
         ).with_plugin("NodeResourcesFit")
+        affinity_conflict = Status.unschedulable(
+            "node(s) conflicted with pod affinity/anti-affinity of pods "
+            "placed earlier in the batch").with_plugin("InterPodAffinity")
+        port_conflict = Status.unschedulable(
+            "node(s) didn't have free ports for the requested pod ports"
+        ).with_plugin("NodePorts")
 
         for i, pi in enumerate(pods):
             idx = int(assign[i])
@@ -425,14 +570,20 @@ class TPUBackend:
                 assignments[pi.key] = None
                 continue
             ni = node_for(idx)
-            # Exact integer re-check of resources (quantization is already
-            # conservative; this also covers any drift).
             if insufficient_resources(pi, ni):
                 assignments[pi.key] = None
                 diagnostics[pi.key] = {ni.name: contention}
                 continue
-            # Stateful plugins must see earlier batch placements.
-            if stateful_batch or pi.has_affinity_constraints or pi.host_ports:
+            if pi.host_ports and any(
+                    (ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip)
+                    and proto == uproto and port == uport
+                    for (ip, proto, port) in pi.host_ports
+                    for (uip, uproto, uport) in ni.used_ports):
+                assignments[pi.key] = None
+                diagnostics[pi.key] = {ni.name: port_conflict}
+                continue
+            if full_check_batch:
+                # Non-IPA stateful plugins in play → full host re-check.
                 wsnap = Snapshot(
                     [working.get(n.name, n) for n in snapshot.nodes],
                     snapshot.generation)
@@ -441,14 +592,20 @@ class TPUBackend:
                 if st.is_success():
                     st = fwk.run_filters(state, pi, working.get(ni.name, ni))
                 if not st.is_success():
-                    # Record the REAL rejection (e.g. anti-affinity symmetry
-                    # against an earlier batch placement), not a fabricated
-                    # resource reason.
                     assignments[pi.key] = None
                     diagnostics[pi.key] = {ni.name: st}
                     continue
+            elif delta_has_terms or pi.has_affinity_constraints:
+                if not _delta_affinity_ok(pi, ni, delta, ct, compiler,
+                                          sel_cache):
+                    assignments[pi.key] = None
+                    diagnostics[pi.key] = {ni.name: affinity_conflict}
+                    continue
             assignments[pi.key] = ni.name
             ni.add_pod(pi)
+            delta.append((pi, ni.labels))
+            if pi.required_affinity_terms or pi.required_anti_affinity_terms:
+                delta_has_terms = True
         return assignments, diagnostics
 
     # -- explainability ------------------------------------------------------
@@ -521,6 +678,74 @@ class TPUBackend:
                     # Feasible at batch start but taken by earlier pods.
                     per_node[name] = contention
             diagnostics[pi.key] = per_node
+
+
+def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict):
+    """Compiled (namespace-set, Selector) per unique term — the delta loop
+    is O(batch²) pairs, so per-pair selector re-parsing would dominate."""
+    key = (id(term), owner_ns)
+    got = sel_cache.get(key)
+    if got is None:
+        from kubernetes_tpu.api.labels import from_label_selector
+        nses = frozenset(term.get("namespaces") or [owner_ns])
+        got = sel_cache[key] = (nses, from_label_selector(
+            term.get("labelSelector")))
+    return got
+
+
+def _delta_affinity_ok(pi, ni, delta, ct, compiler, sel_cache) -> bool:
+    """Inter-pod affinity check of `pi` on node `ni` against only the pods
+    placed earlier in this batch (the batch-start tensor rows already cover
+    the snapshot exactly)."""
+    labels_n = ni.labels
+
+    def matches(term, owner_ns, other) -> bool:
+        nses, sel = _cached_matcher(term, owner_ns, sel_cache)
+        return other.namespace in nses and sel.matches(other.labels)
+
+    # (1) pi's own anti-affinity vs delta placements.
+    for term in pi.required_anti_affinity_terms:
+        tk = term.get("topologyKey", "")
+        tv = labels_n.get(tk)
+        if tv is None:
+            continue
+        for d, labels_m in delta:
+            if labels_m.get(tk) == tv and matches(term, pi.namespace, d):
+                return False
+    # (2) symmetry: delta pods' anti-affinity vs pi.
+    for d, labels_m in delta:
+        for term in d.required_anti_affinity_terms:
+            tk = term.get("topologyKey", "")
+            tv = labels_n.get(tk)
+            if tv is not None and labels_m.get(tk) == tv \
+                    and matches(term, d.namespace, pi):
+                return False
+    # (3) pi's required affinity: delta pods can only ADD matches; the one
+    # invalidation is the first-pod-in-group escape — once a matching pod
+    # exists (placed in this batch), the term must be satisfied in n's
+    # domain for real.
+    for term in pi.required_affinity_terms:
+        tk = term.get("topologyKey", "")
+        tv = labels_n.get(tk)
+        if tv is None:
+            return False
+        delta_matches = [labels_m for d, labels_m in delta
+                         if matches(term, pi.namespace, d)]
+        if any(labels_m.get(tk) == tv for labels_m in delta_matches):
+            continue  # satisfied by a batch sibling in this domain
+        if compiler is not None:
+            per_node, _, total = compiler.affinity_term_presence(
+                term, pi.namespace)
+            idx = ct.name_to_idx.get(ni.name)
+            if idx is not None and per_node[idx] > 0:
+                continue  # satisfied by the snapshot already
+            if total == 0 and not delta_matches:
+                continue  # escape still valid: no match exists anywhere
+            return False
+        # No compiler (shouldn't happen on this path) → be conservative.
+        if delta_matches:
+            return False
+    return True
 
 
 _HOST_REASONS = {

@@ -1,0 +1,152 @@
+"""Differential: tensorized InterPodAffinity filter vs the host plugin."""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.ops.affinity import AffinityCompiler
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.framework import CycleState
+from kubernetes_tpu.scheduler.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.scheduler.types import PodInfo
+
+ZONES = ["z1", "z2", "z3"]
+APPS = ["web", "db", "cache", "batch"]
+HOSTNAME = "kubernetes.io/hostname"
+ZONE = "topology.kubernetes.io/zone"
+
+
+def term(app, key, anti=False):
+    return {"labelSelector": {"matchLabels": {"app": app}},
+            "topologyKey": key}
+
+
+def affinity_spec(required=None, anti=None, rng=None):
+    out = {}
+    if required:
+        out.setdefault("podAffinity", {})[
+            "requiredDuringSchedulingIgnoredDuringExecution"] = required
+    if anti:
+        out.setdefault("podAntiAffinity", {})[
+            "requiredDuringSchedulingIgnoredDuringExecution"] = anti
+    return out
+
+
+def random_affinity_cluster(rng, n_nodes=20, pods_per_node=3):
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        cache.add_node(make_node(
+            f"n{i}", labels={ZONE: rng.choice(ZONES)}))
+        for j in range(rng.randrange(pods_per_node + 1)):
+            app = rng.choice(APPS)
+            aff = None
+            r = rng.random()
+            if r < 0.25:
+                aff = affinity_spec(anti=[term(rng.choice(APPS),
+                                               rng.choice([HOSTNAME, ZONE]))])
+            elif r < 0.35:
+                aff = affinity_spec(required=[term(rng.choice(APPS),
+                                                   rng.choice([HOSTNAME, ZONE]))])
+            cache.add_pod(PodInfo(make_pod(
+                f"res-{i}-{j}", labels={"app": app}, node_name=f"n{i}",
+                affinity=aff, namespace=rng.choice(["default", "other"]))))
+    return cache.update_snapshot()
+
+
+def random_pending_affinity(rng, n=12):
+    pods = []
+    for i in range(n):
+        aff = {}
+        if rng.random() < 0.6:
+            aff = affinity_spec(
+                required=[term(rng.choice(APPS), rng.choice([HOSTNAME, ZONE]))]
+                if rng.random() < 0.5 else None,
+                anti=[term(rng.choice(APPS), rng.choice([HOSTNAME, ZONE]))]
+                if rng.random() < 0.7 else None)
+        pods.append(PodInfo(make_pod(
+            f"pend-{i}", labels={"app": rng.choice(APPS)},
+            affinity=aff or None,
+            namespace=rng.choice(["default", "other"]),
+            uid=f"u{i}")))
+    return pods
+
+
+class TestAffinityDifferential:
+    def test_filter_rows_match_host_plugin(self):
+        plugin = InterPodAffinity()
+        for seed in range(6):
+            rng = random.Random(seed)
+            snapshot = random_affinity_cluster(rng)
+            pending = random_pending_affinity(rng)
+            compiler = AffinityCompiler(snapshot, n_pad=32)
+            for pi in pending:
+                assert compiler.supported(pi)
+                row = compiler.filter_row(pi)
+                state = CycleState()
+                st = plugin.pre_filter(state, pi, snapshot)
+                for j, ni in enumerate(snapshot.nodes):
+                    if st.is_skip():
+                        host_ok = True
+                    else:
+                        host_ok = plugin.filter(state, pi, ni).is_success()
+                    assert bool(row[j]) == host_ok, (
+                        f"seed={seed} pod={pi.key} node={ni.name}: "
+                        f"tensor={bool(row[j])} host={host_ok}")
+
+    def test_first_pod_in_group_rule(self):
+        cache = SchedulerCache()
+        cache.add_node(make_node("n0", labels={ZONE: "z1"}))
+        snapshot = cache.update_snapshot()
+        pod = PodInfo(make_pod(
+            "first", labels={"app": "web"},
+            affinity=affinity_spec(required=[term("web", ZONE)]), uid="u"))
+        compiler = AffinityCompiler(snapshot, n_pad=8)
+        row = compiler.filter_row(pod)
+        assert bool(row[0])  # self-matching first pod may land
+
+        # A pod whose affinity targets a DIFFERENT app (doesn't self-match)
+        # must NOT get the escape.
+        pod2 = PodInfo(make_pod(
+            "notfirst", labels={"app": "db"},
+            affinity=affinity_spec(required=[term("web", ZONE)]), uid="u2"))
+        assert not bool(compiler.filter_row(pod2)[0])
+
+    def test_missing_topology_key_rejects_affinity(self):
+        cache = SchedulerCache()
+        cache.add_node(make_node("nokey"))  # no zone label
+        cache.add_pod(PodInfo(make_pod(
+            "res", labels={"app": "web"}, node_name="nokey")))
+        snapshot = cache.update_snapshot()
+        pod = PodInfo(make_pod(
+            "p", labels={"app": "web"},
+            affinity=affinity_spec(required=[term("web", ZONE)]), uid="u"))
+        compiler = AffinityCompiler(snapshot, n_pad=8)
+        assert not bool(compiler.filter_row(pod)[0])
+
+
+class TestBackendAffinityWorkload:
+    def test_backend_anti_affinity_spreads_exclusively(self):
+        """One pod per hostname-domain via anti-affinity: N pods fill N
+        nodes exactly; pod N+1 is unschedulable."""
+        from kubernetes_tpu.ops import TPUBackend
+        from kubernetes_tpu.scheduler.framework import Framework
+        from kubernetes_tpu.scheduler.plugins.registry import (
+            DEFAULT_SCORE_WEIGHTS, build_plugins)
+
+        cache = SchedulerCache()
+        for i in range(6):
+            cache.add_node(make_node(f"n{i}"))
+        snapshot = cache.update_snapshot()
+        anti = affinity_spec(anti=[term("web", HOSTNAME)])
+        pods = [PodInfo(make_pod(
+            f"w{i}", labels={"app": "web"}, affinity=anti,
+            requests={"cpu": "100m"}, uid=f"u{i}")) for i in range(7)]
+        fwk = Framework(build_plugins(), DEFAULT_SCORE_WEIGHTS)
+        backend = TPUBackend(max_batch=8)
+        assignments, diags = backend.assign(pods, snapshot, fwk)
+        nodes_used = [assignments[p.key] for p in pods if assignments[p.key]]
+        assert len(nodes_used) == 6
+        assert len(set(nodes_used)) == 6
+        unassigned = [p for p in pods if assignments[p.key] is None]
+        assert len(unassigned) == 1
