@@ -152,20 +152,49 @@ class DeploymentController(Controller):
                 await self._scale(new_rs, new_want + up)
                 new_want += up
 
-            # Scale down old RSes within the availability budget: ready
-            # replicas of the new RS stand in for availability.
+            # Scale down old RSes. Count only READY replicas as available
+            # (rolling.go; spec.replicas would overstate it while old pods
+            # are not ready and let scale-down exceed maxUnavailable). First
+            # remove UNHEALTHY old replicas outside the availability budget
+            # (cleanupUnhealthyReplicas) — they contribute nothing to
+            # availability, and without this a permanently-unready old pod
+            # deadlocks the rollout at maxSurge=0.
             new_ready = int(new_rs.get("status", {}).get("readyReplicas", 0))
-            available = new_ready + old_total
-            can_remove = max(0, available - (replicas - max_unavail))
-            for rs in sorted(old_rses,
-                             key=lambda r: r["metadata"].get(
-                                 "creationTimestamp", "")):
+            old_ready = sum(
+                int(r.get("status", {}).get("readyReplicas", 0))
+                for r in old_rses)
+            min_available = replicas - max_unavail
+            new_unavail = max(0, new_want - new_ready)
+            max_cleanup = max(
+                0, new_want + old_total - min_available - new_unavail)
+            oldest_first = sorted(
+                old_rses,
+                key=lambda r: r["metadata"].get("creationTimestamp", ""))
+            # Indexer objects are shared/frozen — track effective replica
+            # counts locally rather than mutating them.
+            eff = {namespaced_name(rs): int(rs["spec"].get("replicas", 0))
+                   for rs in oldest_first}
+            for rs in oldest_first:
+                if max_cleanup <= 0:
+                    break
+                k = namespaced_name(rs)
+                ready = int(rs.get("status", {}).get("readyReplicas", 0))
+                drop = min(max(0, eff[k] - ready), max_cleanup)
+                if drop > 0:
+                    await self._scale(rs, eff[k] - drop)
+                    eff[k] -= drop
+                    max_cleanup -= drop
+
+            available = new_ready + old_ready
+            can_remove = max(0, available - min_available)
+            for rs in oldest_first:
                 if can_remove <= 0:
                     break
-                cur = int(rs["spec"].get("replicas", 0))
-                drop = min(cur, can_remove)
+                k = namespaced_name(rs)
+                drop = min(eff[k], can_remove)
                 if drop > 0:
-                    await self._scale(rs, cur - drop)
+                    await self._scale(rs, eff[k] - drop)
+                    eff[k] -= drop
                     can_remove -= drop
             if old_total > 0 or new_ready < replicas:
                 await self.enqueue_after(key, 0.2)  # keep rolling

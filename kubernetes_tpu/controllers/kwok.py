@@ -69,7 +69,11 @@ class KwokController(Controller):
     async def _lease_loop(self) -> None:
         """Renew every managed node's Lease (nodelease cadence)."""
         while not self._stopped:
-            for name in self._managed:
+            # Copy: fail_node() may discard from _managed while this loop is
+            # suspended at an await; one tick's failure must not kill the task.
+            for name in list(self._managed):
+                if name not in self._managed:
+                    continue
                 try:
                     await self.store.guaranteed_update(
                         "leases", f"kube-node-lease/{name}",
@@ -83,6 +87,8 @@ class KwokController(Controller):
                         pass
                 except StoreError:
                     pass
+                except Exception:
+                    logger.exception("kwok lease renew failed for %s", name)
             await asyncio.sleep(self.lease_period)
 
     @staticmethod

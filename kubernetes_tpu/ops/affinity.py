@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from kubernetes_tpu.api.labels import from_label_selector
 from kubernetes_tpu.ops.labelsets import LabelSigTable, TopologyTable
 from kubernetes_tpu.scheduler.types import PodInfo, Snapshot
 
@@ -252,7 +253,11 @@ class AffinityCompiler:
             per_node, has_key, exists, min_count = \
                 self._spread_domain_counts(pod, c)
             max_skew = c.get("maxSkew", 1)
-            ok = (~exists) | (per_node + 1 - min_count <= max_skew)
+            # selfMatchNum (filtering.go): count the incoming pod only if the
+            # constraint's selector matches the pod's own labels.
+            self_match = 1 if from_label_selector(
+                c.get("labelSelector")).matches(pod.labels) else 0
+            ok = (~exists) | (per_node + self_match - min_count <= max_skew)
             row &= has_key & ok
         row[self.n_real:] = False
         return row

@@ -65,13 +65,16 @@ def _node_eligible(pod: PodInfo, node: NodeInfo) -> bool:
 
 
 class _SpreadState:
-    __slots__ = ("constraints", "counts", "mins")
+    __slots__ = ("constraints", "counts", "mins", "self_match")
 
     def __init__(self):
         self.constraints: list[dict] = []
         # per-constraint-index: {topologyValue: matching pod count}
         self.counts: list[dict[str, int]] = []
         self.mins: list[int] = []
+        # per-constraint-index: 1 if the constraint's selector matches the
+        # incoming pod's own labels (filtering.go selfMatchNum), else 0
+        self.self_match: list[int] = []
 
 
 class PodTopologySpread(Plugin):
@@ -115,6 +118,7 @@ class PodTopologySpread(Plugin):
                         counts[tv] += 1
             s.counts.append(dict(counts))
             s.mins.append(min(counts.values()) if counts else 0)
+            s.self_match.append(1 if sel.matches(pod.labels) else 0)
         return s
 
     # -- Filter path -------------------------------------------------------
@@ -140,7 +144,7 @@ class PodTopologySpread(Plugin):
             count = s.counts[i].get(tv)
             if count is None:
                 continue  # node domain not eligible — treated as fresh
-            if count + 1 - s.mins[i] > c.get("maxSkew", 1):
+            if count + s.self_match[i] - s.mins[i] > c.get("maxSkew", 1):
                 return Status.unschedulable(
                     "node(s) didn't match pod topology spread constraints")
         return Status.success()

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Awaitable, Callable, Mapping
 
@@ -104,6 +105,41 @@ class ListResult:
 # under scheduler_perf churn.)
 DEFAULT_EVENT_WINDOW = 200_000
 BOOKMARK_INTERVAL_S = 5.0
+
+# Debug guard (KTPU_DEBUG_FREEZE=1, enabled in tests): stored objects — which
+# watch events share — are recursively frozen, so a handler that mutates a
+# delivered object fails loudly instead of silently corrupting the source of
+# truth with no RV bump. deep_copy() rebuilds plain dicts/lists, so copies
+# handed to callers stay mutable.
+_DEBUG_FREEZE = bool(int(os.environ.get("KTPU_DEBUG_FREEZE", "0") or "0"))
+
+
+def _frozen(*_a, **_k):
+    raise TypeError(
+        "attempt to mutate a stored/watch-delivered object; informer handlers "
+        "must treat delivered objects as immutable (copy before modifying)")
+
+
+class FrozenDict(dict):
+    __setitem__ = __delitem__ = __ior__ = _frozen
+    setdefault = update = pop = popitem = clear = _frozen
+
+
+class FrozenList(list):
+    __setitem__ = __delitem__ = __iadd__ = __imul__ = _frozen
+    append = extend = insert = pop = remove = clear = sort = reverse = _frozen
+
+
+def deep_freeze(obj):
+    if isinstance(obj, dict):
+        return FrozenDict((k, deep_freeze(v)) for k, v in obj.items())
+    if isinstance(obj, list):
+        return FrozenList(deep_freeze(v) for v in obj)
+    return obj
+
+
+def _maybe_freeze(obj: dict) -> dict:
+    return deep_freeze(obj) if _DEBUG_FREEZE else obj
 
 
 class MVCCStore:
@@ -216,6 +252,7 @@ class MVCCStore:
         set_creation_timestamp(obj)
         rv = self._next_rv()
         obj["metadata"]["resourceVersion"] = str(rv)
+        obj = _maybe_freeze(obj)
         table[key] = obj
         # The watch event SHARES the stored object: watch consumers must
         # never mutate delivered objects — the convention client-go's shared
@@ -223,7 +260,7 @@ class MVCCStore:
         # Updates never mutate stored objects in place (they replace
         # table[key]), so shared references stay frozen at their RV. The
         # *returned* object stays a private copy: read-modify-write on it is
-        # idiomatic for callers.
+        # idiomatic for callers. KTPU_DEBUG_FREEZE=1 enforces the convention.
         self._record(resource, Event("ADDED", obj, rv))
         return deep_copy(obj)
 
@@ -256,6 +293,7 @@ class MVCCStore:
         rv = self._next_rv()
         obj["metadata"]["resourceVersion"] = str(rv)
         prev_labels = dict(current.get("metadata", {}).get("labels") or {})
+        obj = _maybe_freeze(obj)
         table[key] = obj
         # Shared-object discipline: see create().
         self._record(resource, Event("MODIFIED", obj, rv, prev_labels))
@@ -291,7 +329,9 @@ class MVCCStore:
         rv = self._next_rv()
         tomb = deep_copy(current)
         tomb["metadata"]["resourceVersion"] = str(rv)
-        self._record(resource, Event("DELETED", tomb, rv))
+        # deep_freeze builds a fresh container tree, so the returned tomb
+        # stays a private mutable copy either way.
+        self._record(resource, Event("DELETED", _maybe_freeze(tomb), rv))
         return tomb
 
     async def list(

@@ -13,6 +13,11 @@ import os
 # jax.config jax_platforms="axon,cpu" (the one real TPU) at interpreter
 # start, overriding the env var — so override the *config* after import.
 # KTPU_TEST_PLATFORM runs the suite against real hardware instead.
+# Enforce the "handlers never mutate delivered/stored objects" convention in
+# tests: watch events share the stored dict, so a violating handler must fail
+# loudly here rather than silently corrupt the store (see store/mvcc.py).
+os.environ.setdefault("KTPU_DEBUG_FREEZE", "1")
+
 _platform = os.environ.get("KTPU_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")

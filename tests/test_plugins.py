@@ -296,6 +296,27 @@ class TestPodTopologySpread:
         # zone c: 0+1-0 = 1 ≤ 1 → allowed
         assert plug.filter(state, pod, n3).is_success()
 
+    def test_self_match_excluded_when_selector_misses_pod(self):
+        """filtering.go selfMatchNum: a pod whose spread selector does NOT
+        match its own labels is not counted as +1 on placement."""
+        sel = {"matchLabels": {"app": "web"}}
+        cons = [{"maxSkew": 1, "topologyKey": "zone",
+                 "whenUnsatisfiable": "DoNotSchedule", "labelSelector": sel}]
+        w = lambda i: pp(f"w{i}", labels={"app": "web"})
+        n1 = ni("n1", labels={"zone": "a"}, pods=[w(1)])
+        n2 = ni("n2", labels={"zone": "b"})
+        snap = Snapshot([n1, n2])
+        plug = PodTopologySpread()
+        # Pod labeled "other": selector doesn't match it, selfMatch = 0.
+        pod = pp("x", labels={"app": "other"},
+                 topology_spread_constraints=cons)
+        state = CycleState()
+        assert plug.pre_filter(state, pod, snap).is_success()
+        # zone a: 1 + 0 - min(0) = 1 ≤ 1 → allowed (was wrongly blocked
+        # when the incoming pod was counted unconditionally).
+        assert plug.filter(state, pod, n1).is_success()
+        assert plug.filter(state, pod, n2).is_success()
+
     def test_missing_topology_key_unresolvable(self):
         cons = [{"maxSkew": 1, "topologyKey": "zone",
                  "whenUnsatisfiable": "DoNotSchedule",
