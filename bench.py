@@ -36,7 +36,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", choices=PRESETS, default="5k")
     ap.add_argument("--backend", choices=["host", "tpu"], default="tpu")
-    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=4096,
+                    help="pods popped per scheduling super-batch; the "
+                         "backend chunks + pipelines internally")
+    ap.add_argument("--chunk", type=int, default=1024,
+                    help="backend solve chunk (jit batch signature)")
     args = ap.parse_args(argv)
 
     from kubernetes_tpu.perf.scheduler_perf import PerfRunner
@@ -46,7 +50,7 @@ def main(argv=None) -> int:
     batch = 1
     if args.backend == "tpu":
         from kubernetes_tpu.ops import TPUBackend
-        backend = TPUBackend(max_batch=args.batch_size)
+        backend = TPUBackend(max_batch=args.chunk)
         batch = args.batch_size
 
     # Warmup phase triggers jit compilation (first TPU compile is ~20-40s)

@@ -30,6 +30,12 @@ _QTY_RE = re.compile(
 )
 
 
+#: Memo for string quantities: workloads come from pod templates, so a
+#: handful of distinct strings are parsed millions of times at perf scale.
+_PARSE_CACHE: dict[str, int] = {}
+_PARSE_CACHE_MAX = 4096
+
+
 def parse_quantity(s: Union[str, int, float, None]) -> int:
     """Parse a quantity into integer milli-units.
 
@@ -45,6 +51,9 @@ def parse_quantity(s: Union[str, int, float, None]) -> int:
         return s * 1000
     if isinstance(s, float):
         return round(s * 1000)
+    cached = _PARSE_CACHE.get(s)
+    if cached is not None:
+        return cached
     m = _QTY_RE.match(s)
     if not m:
         raise ValueError(f"invalid quantity: {s!r}")
@@ -53,8 +62,10 @@ def parse_quantity(s: Union[str, int, float, None]) -> int:
         mult = _BIN[suffix]
     else:
         mult = _DEC[suffix]
-    val = float(num) * mult * 1000
-    return round(val)
+    val = round(float(num) * mult * 1000)
+    if len(_PARSE_CACHE) < _PARSE_CACHE_MAX:
+        _PARSE_CACHE[s] = val
+    return val
 
 
 def format_quantity(milli: int) -> str:

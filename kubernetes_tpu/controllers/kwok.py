@@ -36,19 +36,36 @@ class KwokController(Controller):
         self.lease_period = lease_period
         self.name_prefix = name_prefix
         self._managed: set[str] = set()
+        self._run_queue: list[str] = []
+        self._run_draining = False
 
     def setup(self, factory: InformerFactory) -> None:
         self.pod_informer = factory.informer("pods")
 
         def on_pod(obj):
             # Fake kubelet: a pod bound to a managed node starts "Running".
+            # Keys are buffered and drained by ONE task (not one task per
+            # pod — at 10k pods/s the per-pod task + write overhead is a
+            # top host cost).
             node = obj.get("spec", {}).get("nodeName")
             if node in self._managed and \
                     obj.get("status", {}).get("phase") == "Pending":
-                asyncio.ensure_future(self._mark_running(namespaced_name(obj)))
+                self._run_queue.append(namespaced_name(obj))
+                if not self._run_draining:
+                    self._run_draining = True
+                    asyncio.ensure_future(self._drain_mark_running())
 
         self.pod_informer.add_event_handler(ResourceEventHandler(
             on_add=on_pod, on_update=lambda o, n: on_pod(n)))
+
+    async def _drain_mark_running(self) -> None:
+        try:
+            while self._run_queue:
+                batch, self._run_queue = self._run_queue, []
+                for key in batch:
+                    await self._mark_running(key)
+        finally:
+            self._run_draining = False
 
     async def register_nodes(self) -> None:
         for i in range(self.node_count):
@@ -107,7 +124,8 @@ class KwokController(Controller):
                 conds.append({"type": "Ready", "status": "True"})
             return pod
         try:
-            await self.store.guaranteed_update("pods", key, mutate)
+            await self.store.guaranteed_update(
+                "pods", key, mutate, return_copy=False)
         except StoreError:
             pass
 

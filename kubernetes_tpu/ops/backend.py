@@ -53,6 +53,11 @@ DEVICE_FILTER_PLUGINS = {"NodeResourcesFit", "TaintToleration"}
 DEVICE_SCORE_PLUGINS = {
     "NodeResourcesFit", "NodeResourcesBalancedAllocation", "TaintToleration"}
 
+#: In-flight chunk solves before a fetch is forced. Depth 2 lets the fetch
+#: round-trip of chunk k hide behind the solves of chunks k+1 and k+2 —
+#: chunk results have no host-side dependency until verify.
+_PIPELINE_DEPTH = 2
+
 #: Static node-predicate plugins whose (pod-spec → node row) is cacheable by
 #: spec signature while the node set is unchanged.
 STATIC_ROW_PLUGINS = {"NodeAffinity", "NodeName", "NodeUnschedulable"}
@@ -99,16 +104,37 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
 
 
 @partial(jax.jit, static_argnames=("strategy", "use_auction"))
-def _mask_and_solve(alloc_q, used_q, used_nz_q, alloc_pods, used_pods,
-                    req_q, req_nz_q, untol_f, untol_p,
-                    taint_f_mat, taint_p_mat, static_mask, host_scores,
-                    fit_col_w, bal_col_mask, shape_u, shape_s,
-                    w_fit, w_bal, w_taint, taint_filter_on,
-                    strategy: str, use_auction: bool):
-    """One fused device pass: plugin masks → scores → assignment.
+def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
+                       taint_f_mat, taint_p_mat, static_mask, host_scores,
+                       fit_col_w, bal_col_mask, shape_u, shape_s,
+                       w_fit, w_bal, w_taint, taint_filter_on,
+                       strategy: str, use_auction: bool):
+    """One fused device pass: plugin masks → scores → assignment → state.
 
-    Returns (assign (P,), fit0 (P,N), taint_ok (P,N), feasible (P,N)).
+    The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
+    int32 array — each host→device transfer costs ~25–100 ms of relay
+    latency regardless of size, so inputs are packed to one upload apiece)
+    is device-resident and CHAINED: the program returns the post-assignment
+    state so the next chunk's solve can be dispatched without any host
+    round-trip — SURVEY §2.8's pipelining row (solve batch k+1 overlaps
+    verify/bind of batch k). Capacity accounting inside the solver is exact
+    (quantized-conservative integers), so the chain is as correct as
+    re-uploading from the host.
+
+    pod_pack is (P, 2R+tf+tp) int32: req_q ‖ req_nz_q ‖ untol_f ‖ untol_p.
+
+    Returns (assign (P,), used_pack', fit0 (P,N), taint_ok (P,N)).
     """
+    r = alloc_q.shape[1]
+    tf = taint_f_mat.shape[1]
+    used_q = used_pack[:, :r]
+    used_nz_q = used_pack[:, r:2 * r]
+    used_pods = used_pack[:, 2 * r]
+    req_q = pod_pack[:, :r]
+    req_nz_q = pod_pack[:, r:2 * r]
+    untol_f = pod_pack[:, 2 * r:2 * r + tf].astype(jnp.bool_)
+    untol_p = pod_pack[:, 2 * r + tf:].astype(jnp.bool_)
+
     fit0 = kernels.fit_filter_mask(alloc_q, used_q, used_pods, alloc_pods, req_q)
     taint_ok = kernels.taint_filter_mask(taint_f_mat, untol_f)
     taint_ok = taint_ok | jnp.logical_not(taint_filter_on)
@@ -134,7 +160,18 @@ def _mask_and_solve(alloc_q, used_q, used_nz_q, alloc_pods, used_pods,
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
             static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal, strategy)
-    return assign, fit0, taint_ok, feasible
+
+    # Post-assignment state update (scatter-add of assigned requests).
+    # Padding/unassigned rows scatter to a dummy row (index N, dropped).
+    n = alloc_q.shape[0]
+    hit = assign >= 0
+    tgt = jnp.where(hit, assign, n)
+    inc = jnp.concatenate(
+        [req_q, req_nz_q, hit.astype(jnp.int32)[:, None]], axis=1)
+    used_pack2 = used_pack + jnp.zeros(
+        (n + 1, used_pack.shape[1]), used_pack.dtype
+    ).at[tgt].add(jnp.where(hit[:, None], inc, 0))[:n]
+    return assign, used_pack2, fit0, taint_ok
 
 
 class TPUBackend:
@@ -142,10 +179,40 @@ class TPUBackend:
     ({pod_key: node_name|None}, {pod_key: {node_name: Status}})."""
 
     def __init__(self, max_batch: int = 128, solver_name: str = "greedy",
-                 resources: Sequence[str] | None = None):
+                 resources: Sequence[str] | None = None,
+                 mesh: object = "auto"):
         self.max_batch = max_batch
         self.solver_name = solver_name
         self._pinned_resources = list(resources) if resources else None
+        # Multi-device: shard the nodes axis over an ICI mesh
+        # (SURVEY §5.7 — the TP-like axis). Inputs are placed with
+        # NamedSharding and the SAME jit program auto-partitions (XLA
+        # inserts the cross-shard reductions for the solver's per-step
+        # argmax). mesh="auto" builds a 1-D nodes mesh over the largest
+        # power-of-two device count (divides NODE_PAD, so any padded N
+        # shards evenly); None forces single-device.
+        if mesh == "auto":
+            try:
+                ndev = len(jax.devices())
+            except Exception:  # pragma: no cover - no backend at all
+                ndev = 1
+            if ndev > 1:
+                from kubernetes_tpu.parallel import build_mesh
+                n = 1 << (ndev.bit_length() - 1)  # largest power of two ≤ ndev
+                mesh = build_mesh(n)
+            else:
+                mesh = None
+        self.mesh = mesh
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from kubernetes_tpu.parallel import NODES_AXIS
+            self._sh_nodes_mat = NamedSharding(
+                self.mesh, PartitionSpec(NODES_AXIS, None))
+            self._sh_nodes_vec = NamedSharding(
+                self.mesh, PartitionSpec(NODES_AXIS))
+            self._sh_pn = NamedSharding(
+                self.mesh, PartitionSpec(None, NODES_AXIS))
+            self._sh_rep = NamedSharding(self.mesh, PartitionSpec())
         self._ct: ClusterTensors | None = None
         # (plugin, sig) -> np row; valid while _row_fp matches.
         self._row_cache: dict[tuple[str, str], np.ndarray] = {}
@@ -159,6 +226,22 @@ class TPUBackend:
         # the node-static fingerprint moves.
         self._dev_static: dict[str, object] = {}
         self._dev_static_fp: tuple | None = None
+        self._fwk_params_cache: dict[tuple, dict] = {}
+        # Chained device-resident used-state, ONE packed (N, 2R+1) int32
+        # array (used_q ‖ used_nz_q ‖ used_pods): uploaded fresh from the
+        # snapshot at each assign() entry, then updated ON DEVICE by each
+        # chunk's solve so successive chunks dispatch with no host
+        # round-trip.
+        self._dev_used = None
+
+    # -- device placement ----------------------------------------------------
+
+    def _put(self, arr, kind: str = "rep"):
+        """Upload with the mesh sharding for `kind` ("nodes_mat",
+        "nodes_vec", "pn", "rep"); plain transfer on a single device."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, getattr(self, "_sh_" + kind))
 
     # -- snapshot compilation ----------------------------------------------
 
@@ -244,13 +327,119 @@ class TPUBackend:
 
     def assign(self, pods: Sequence[PodInfo], snapshot: Snapshot,
                fwk: Framework):
+        """Synchronous driver. Batches larger than max_batch are chunked
+        internally and PIPELINED: chunk k+1's solve is dispatched (device
+        state chains on device) before chunk k's assignments are fetched,
+        so the host verify of chunk k overlaps the device solve of k+1."""
+        ctx = self._start(pods, snapshot, fwk)
+        for run in self._pipeline(ctx):
+            self._finalize_chunk(run, np.asarray(run["assign_d"]), ctx)
+        return ctx.assignments, ctx.diagnostics
+
+    async def assign_async(self, pods: Sequence[PodInfo], snapshot: Snapshot,
+                           fwk: Framework):
+        """Pipelined driver for the scheduler's event loop: same chunk
+        pipeline as assign(), with the device→host fetch awaited in a worker
+        thread so binding tasks keep draining during the device/relay wait."""
+        import asyncio
+
+        ctx = self._start(pods, snapshot, fwk)
+        for run in self._pipeline(ctx):
+            got = await asyncio.to_thread(np.asarray, run["assign_d"])
+            self._finalize_chunk(run, got, ctx)
+        return ctx.assignments, ctx.diagnostics
+
+    def _pipeline(self, ctx: "_AssignCtx"):
+        """Yield dispatched chunk runs in finalize order, keeping up to
+        _PIPELINE_DEPTH solves in flight ahead of the consumer's fetch."""
+        from collections import deque
+
+        pending: deque = deque()
+        for chunk in ctx.chunks:
+            pending.append(
+                self._dispatch_chunk(self._prep_chunk(chunk, ctx), ctx))
+            if len(pending) > _PIPELINE_DEPTH:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
+
+    def _start(self, pods: Sequence[PodInfo], snapshot: Snapshot,
+               fwk: Framework) -> "_AssignCtx":
         ct = self._tensors(snapshot)
         pods = list(pods)
-        if len(pods) > self.max_batch:
-            # The scheduler chunks to max_batch; a direct caller exceeding it
-            # would otherwise have pods silently reported unschedulable.
-            raise ValueError(
-                f"batch of {len(pods)} exceeds max_batch={self.max_batch}")
+        ctx = _AssignCtx()
+        ctx.snapshot, ctx.fwk, ctx.ct = snapshot, fwk, ct
+        ctx.chunks = [pods[lo:lo + self.max_batch]
+                      for lo in range(0, len(pods), self.max_batch)]
+        ctx.assignments, ctx.diagnostics = {}, {}
+        # Shared verify state: later chunks are checked against earlier
+        # chunks' accepted placements (working snapshot + delta list).
+        ctx.working = {}
+        ctx.delta = []
+        ctx.delta_has_terms = False
+        ctx.sel_cache = {}
+        ctx.params = self._fwk_params(fwk, ct)
+        # Fresh used-state upload (ONE packed array, ~80 KB) per call;
+        # chunks chain on device from here.
+        self._dev_used = self._put(np.concatenate(
+            [ct.used_q, ct.used_nz_q,
+             ct.used_pods.astype(np.int32)[:, None]], axis=1), "nodes_mat")
+        return ctx
+
+    def _fwk_params(self, fwk: Framework, ct: ClusterTensors) -> dict:
+        # Cached per (framework, resource columns): the device scalars are
+        # ~9 separate host→device transfers, each costing relay latency.
+        # The entry HOLDS the framework so its id can't be recycled by a
+        # new Framework and serve stale weights; identity is re-checked.
+        key = (id(fwk), tuple(ct.resources))
+        cached = self._fwk_params_cache.get(key)
+        if cached is not None and cached[0] is fwk:
+            return cached[1]
+        if len(self._fwk_params_cache) > 64:
+            self._fwk_params_cache.clear()
+        score_plugins = {p.NAME: p for p in fwk.score_plugins}
+        fit_plugin = score_plugins.get("NodeResourcesFit")
+        strategy = getattr(fit_plugin, "strategy_type", "LeastAllocated")
+        fit_col_w = np.zeros((len(ct.resources),), dtype=np.float32)
+        if fit_plugin is not None:
+            for spec in fit_plugin.score_resources:
+                j = ct.r_index.get(spec["name"])
+                if j is not None:
+                    fit_col_w[j] = spec.get("weight", 1)
+        bal_plugin = score_plugins.get("NodeResourcesBalancedAllocation")
+        bal_col_mask = np.zeros((len(ct.resources),), dtype=np.bool_)
+        if bal_plugin is not None:
+            for r in bal_plugin.resources:
+                j = ct.r_index.get(r)
+                if j is not None:
+                    bal_col_mask[j] = True
+        shape_pts = getattr(fit_plugin, "shape", None) or [
+            {"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]
+        w = fwk.score_weights
+        filter_names = {p.NAME for p in fwk.filter_plugins}
+        params = {
+            "strategy": strategy,
+            "fit_col_w": self._put(fit_col_w),
+            "bal_col_mask": self._put(bal_col_mask),
+            "shape_u": self._put(
+                np.array([p["utilization"] for p in shape_pts], np.float32)),
+            "shape_s": self._put(
+                np.array([p["score"] for p in shape_pts], np.float32)),
+            "w_fit": jnp.float32(
+                w.get("NodeResourcesFit", 1) if fit_plugin else 0),
+            "w_bal": jnp.float32(
+                w.get("NodeResourcesBalancedAllocation", 1) if bal_plugin else 0),
+            "w_taint": jnp.float32(
+                w.get("TaintToleration", 3)
+                if "TaintToleration" in score_plugins else 0),
+            "taint_filter_on": jnp.bool_("TaintToleration" in filter_names),
+            "filter_names": filter_names,
+        }
+        self._fwk_params_cache[key] = (fwk, params)
+        return params
+
+    def _prep_chunk(self, pods: list[PodInfo], ctx: "_AssignCtx") -> dict:
+        ct, snapshot, fwk = ctx.ct, ctx.snapshot, ctx.fwk
         P = self.max_batch
         batch = PodBatch(pods, ct, P)
         N = ct.n_pad
@@ -289,6 +478,11 @@ class TPUBackend:
         stateful_pods: set[int] = set()
 
         def apply_row(pname: str, i: int, row: np.ndarray) -> None:
+            # All-true rows are no-ops; applying them would dirty the mask
+            # and force a (P,N) re-upload every batch — the relay-attached
+            # TPU's dominant cost (~0.3 s per 2.6 MB mask at 5k nodes).
+            if row.all():
+                return
             ok = host_filter_fail.get(pname)
             if ok is None:  # setdefault would allocate the array per call
                 ok = host_filter_fail[pname] = np.ones((P, N), dtype=np.bool_)
@@ -433,95 +627,95 @@ class TPUBackend:
                     host_scores[i, ct.name_to_idx[nname]] += w * s
                 scores_modified = True
 
-        # Device pass.
-        fit_plugin = score_plugins.get("NodeResourcesFit")
-        strategy = getattr(fit_plugin, "strategy_type", "LeastAllocated")
-        fit_col_w = np.zeros((len(ct.resources),), dtype=np.float32)
-        if fit_plugin is not None:
-            for spec in fit_plugin.score_resources:
-                j = ct.r_index.get(spec["name"])
-                if j is not None:
-                    fit_col_w[j] = spec.get("weight", 1)
-        bal_plugin = score_plugins.get("NodeResourcesBalancedAllocation")
-        bal_col_mask = np.zeros((len(ct.resources),), dtype=np.bool_)
-        if bal_plugin is not None:
-            for r in bal_plugin.resources:
-                j = ct.r_index.get(r)
-                if j is not None:
-                    bal_col_mask[j] = True
-        shape_pts = getattr(fit_plugin, "shape", None) or [
-            {"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]
-        shape_u = np.array([p["utilization"] for p in shape_pts], np.float32)
-        shape_s = np.array([p["score"] for p in shape_pts], np.float32)
-
         # Reuse device-resident constants when untouched (remote-TPU upload
         # bandwidth is the bottleneck at 5k nodes).
         if mask_modified:
-            dev_mask = jnp.asarray(static_mask)
+            dev_mask = self._put(static_mask, "pn")
         else:
             dev_mask = self._dev_base_mask.get(base_key)
             if dev_mask is None:
                 dev_mask = self._dev_base_mask[base_key] = \
-                    jnp.asarray(static_mask)
+                    self._put(static_mask, "pn")
         if scores_modified:
-            dev_scores = jnp.asarray(host_scores)
+            dev_scores = self._put(host_scores, "pn")
         else:
             dev_scores = self._dev_zero_scores.get((P, N))
             if dev_scores is None:
                 dev_scores = self._dev_zero_scores[(P, N)] = \
-                    jnp.asarray(host_scores)
+                    self._put(host_scores, "pn")
 
+        return {
+            "pods": pods, "batch": batch,
+            "dev_mask": dev_mask, "dev_scores": dev_scores,
+            "host_filter_fail": host_filter_fail,
+            "unknown_res": unknown_res, "stateful_pods": stateful_pods,
+        }
+
+    def _dispatch_chunk(self, prep: dict, ctx: "_AssignCtx") -> dict:
+        """Dispatch the fused solve for one chunk; device used-state chains
+        through self._dev_used without host sync."""
+        ct, p = ctx.ct, ctx.params
+        batch = prep["batch"]
         if self._dev_static_fp != ct._static_fp or \
                 self._dev_static.get("alloc_shape") != ct.alloc_q.shape:
             self._dev_static = {
-                "alloc_q": jnp.asarray(ct.alloc_q),
-                "alloc_pods": jnp.asarray(ct.alloc_pods),
-                "taint_f": jnp.asarray(ct.taint_filter_mat),
-                "taint_p": jnp.asarray(ct.taint_prefer_mat),
+                "alloc_q": self._put(ct.alloc_q, "nodes_mat"),
+                "alloc_pods": self._put(ct.alloc_pods, "nodes_vec"),
+                "taint_f": self._put(ct.taint_filter_mat, "nodes_mat"),
+                "taint_p": self._put(ct.taint_prefer_mat, "nodes_mat"),
                 "alloc_shape": ct.alloc_q.shape,
             }
             self._dev_static_fp = ct._static_fp
 
-        w = fwk.score_weights
-        assign_d, fit0_d, taint_ok_d, feasible_d = _mask_and_solve(
-            self._dev_static["alloc_q"], jnp.asarray(ct.used_q),
-            jnp.asarray(ct.used_nz_q), self._dev_static["alloc_pods"],
-            jnp.asarray(ct.used_pods),
-            jnp.asarray(batch.req_q), jnp.asarray(batch.req_nz_q),
-            jnp.asarray(batch.untol_filter), jnp.asarray(batch.untol_prefer),
+        pod_pack = np.concatenate(
+            [batch.req_q, batch.req_nz_q,
+             batch.untol_filter.astype(np.int32),
+             batch.untol_prefer.astype(np.int32)], axis=1)
+        assign_d, used_pack2, fit0_d, taint_ok_d = _mask_solve_update(
+            self._dev_static["alloc_q"], self._dev_used,
+            self._dev_static["alloc_pods"], self._put(pod_pack),
             self._dev_static["taint_f"], self._dev_static["taint_p"],
-            dev_mask, dev_scores,
-            jnp.asarray(fit_col_w), jnp.asarray(bal_col_mask),
-            jnp.asarray(shape_u), jnp.asarray(shape_s),
-            jnp.float32(w.get("NodeResourcesFit", 1) if fit_plugin else 0),
-            jnp.float32(w.get("NodeResourcesBalancedAllocation", 1) if bal_plugin else 0),
-            jnp.float32(w.get("TaintToleration", 3)
-                        if "TaintToleration" in score_plugins else 0),
-            jnp.bool_("TaintToleration" in filter_names),
-            strategy, self.solver_name == "auction",
+            prep["dev_mask"], prep["dev_scores"],
+            p["fit_col_w"], p["bal_col_mask"], p["shape_u"], p["shape_s"],
+            p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
+            p["strategy"], self.solver_name == "auction",
         )
-        assign = np.asarray(assign_d)[: batch.p_real]
+        self._dev_used = used_pack2
+        # Start the device→host copy now; the fetch in _finalize_chunk then
+        # overlaps the next chunk's solve (and, in assign_async, bind tasks).
+        try:
+            assign_d.copy_to_host_async()
+        except AttributeError:
+            pass
+        prep["assign_d"] = assign_d
+        prep["fit0_d"] = fit0_d
+        prep["taint_ok_d"] = taint_ok_d
+        return prep
 
-        # Host verify + working-state accumulation (hard part #1).
-        assignments, diagnostics = self._verify(
-            pods, assign, snapshot, fwk, ct, stateful_pods,
-            compiler=getattr(self, "_affinity", None))
+    def _finalize_chunk(self, run: dict, assign_np: np.ndarray,
+                        ctx: "_AssignCtx") -> None:
+        pods, batch = run["pods"], run["batch"]
+        assign = assign_np[: batch.p_real]
+
+        # Host verify + working-state accumulation (hard part #1). The
+        # verify context is shared across chunks, so later chunks are
+        # checked against earlier chunks' accepted placements.
+        self._verify(pods, assign, ctx, run["stateful_pods"])
 
         # Lazy per-plugin diagnostics for unassigned pods.
         need_diag = [i for i, pi in enumerate(pods)
-                     if assignments.get(pi.key) is None
-                     and pi.key not in diagnostics]
+                     if ctx.assignments.get(pi.key) is None
+                     and pi.key not in ctx.diagnostics]
         if need_diag:
             self._build_diagnostics(
-                need_diag, pods, ct, batch,
-                np.asarray(fit0_d), np.asarray(taint_ok_d),
-                host_filter_fail, filter_names, diagnostics, unknown_res)
-        return assignments, diagnostics
+                need_diag, pods, ctx.ct, batch,
+                np.asarray(run["fit0_d"]), np.asarray(run["taint_ok_d"]),
+                run["host_filter_fail"], ctx.params["filter_names"],
+                ctx.diagnostics, run["unknown_res"])
 
     # -- verification --------------------------------------------------------
 
-    def _verify(self, pods, assign, snapshot, fwk, ct, stateful_pods,
-                compiler=None):
+    def _verify(self, pods, assign, ctx: "_AssignCtx", stateful_pods):
         """Post-solve verification (hard part #1: solve → verify → requeue).
 
         The batch-start masks are EXACT w.r.t. the snapshot (host rows use
@@ -535,15 +729,19 @@ class TPUBackend:
         - host ports: against the working node's accumulated ports
         - anything else stateful (PodTopologySpread & friends in
           `stateful_pods`): full host re-check against a working snapshot
+
+        The working snapshot / delta list live on ctx and are SHARED across
+        chunks of one assign() call, so chunk k+1 is verified against chunk
+        k's accepted placements.
         """
-        assignments: dict[str, str | None] = {}
-        diagnostics: dict[str, dict[str, Status]] = {}
-        working: dict[str, NodeInfo] = {}
-        #: batch placements so far: (PodInfo, node_labels)
-        delta: list[tuple[PodInfo, dict]] = []
-        #: any delta pod carries required anti-affinity or affinity terms
-        delta_has_terms = False
-        sel_cache: dict = {}  # compiled selectors for the delta loops
+        snapshot, fwk, ct = ctx.snapshot, ctx.fwk, ctx.ct
+        compiler = getattr(self, "_affinity", None)
+        assignments = ctx.assignments
+        diagnostics = ctx.diagnostics
+        working = ctx.working
+        delta = ctx.delta
+        delta_has_terms = ctx.delta_has_terms
+        sel_cache = ctx.sel_cache
 
         def node_for(idx: int) -> NodeInfo:
             name = ct.node_names[idx]
@@ -606,7 +804,7 @@ class TPUBackend:
             delta.append((pi, ni.labels))
             if pi.required_affinity_terms or pi.required_anti_affinity_terms:
                 delta_has_terms = True
-        return assignments, diagnostics
+        ctx.delta_has_terms = delta_has_terms
 
     # -- explainability ------------------------------------------------------
 
@@ -678,6 +876,15 @@ class TPUBackend:
                     # Feasible at batch start but taken by earlier pods.
                     per_node[name] = contention
             diagnostics[pi.key] = per_node
+
+
+class _AssignCtx:
+    """Per-assign()-call state: the chunk list, per-framework device params,
+    accumulated results, and the cross-chunk verify context."""
+
+    __slots__ = ("snapshot", "fwk", "ct", "chunks", "params",
+                 "assignments", "diagnostics",
+                 "working", "delta", "delta_has_terms", "sel_cache")
 
 
 def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict):

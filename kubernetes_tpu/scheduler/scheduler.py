@@ -321,14 +321,12 @@ class Scheduler:
             by_profile: dict[str, list[PodInfo]] = {}
             for pi in pods:
                 by_profile.setdefault(pi.scheduler_name, []).append(pi)
-            # Chunk to the backend's batch capacity (its jit signature is
-            # fixed at max_batch); re-snapshot between chunks so later
-            # chunks see earlier chunks' assumes.
-            maxb = getattr(self.backend, "max_batch", None) or len(pods)
+            # The backend chunks to its own batch capacity internally and
+            # PIPELINES the chunks (device state chains on device; chunk
+            # k+1's solve overlaps chunk k's host verify) — SURVEY §2.8.
             for group in by_profile.values():
-                for lo in range(0, len(group), maxb):
-                    await self._schedule_via_backend(group[lo:lo + maxb], snapshot)
-                    snapshot = self.cache.update_snapshot()
+                await self._schedule_via_backend(group, snapshot)
+                snapshot = self.cache.update_snapshot()
             return
         for pi in pods:
             await self._schedule_host_path(pi, snapshot)
@@ -339,7 +337,13 @@ class Scheduler:
         """Batched path: the backend returns {pod_key: node_name | None}."""
         fwk = self.profiles.get(pods[0].scheduler_name) or next(iter(self.profiles.values()))
         t0 = time.perf_counter()
-        assignments, diagnostics = self.backend.assign(pods, snapshot, fwk)
+        if hasattr(self.backend, "assign_async"):
+            # Pipelined path: device fetches run in a worker thread, so
+            # binding tasks keep draining during device/relay waits.
+            assignments, diagnostics = await self.backend.assign_async(
+                pods, snapshot, fwk)
+        else:
+            assignments, diagnostics = self.backend.assign(pods, snapshot, fwk)
         elapsed = time.perf_counter() - t0
         for pi in pods:
             node = assignments.get(pi.key)

@@ -192,6 +192,33 @@ class TestKernelsVsHost:
 # ---------------------------------------------------------------------------
 
 class TestBackendVsOracle:
+    def test_mesh_path_is_active_and_matches_single_device(self):
+        """The 8-virtual-device conftest must put the backend on its
+        node-axis mesh (the production multi-chip path), and the sharded
+        program must produce the same assignments as mesh=None."""
+        rng = random.Random(7)
+        snapshot = random_cluster(rng, 30)
+        pods = random_pending(rng, 16)
+        fwk = default_fwk()
+        sharded = TPUBackend(max_batch=8)
+        assert sharded.mesh is not None, \
+            "expected auto mesh on the 8-device test platform"
+        single = TPUBackend(max_batch=8, mesh=None)
+        a_sh, _ = sharded.assign(pods, snapshot, fwk)
+        a_si, _ = single.assign(pods, snapshot, fwk)
+        assert a_sh == a_si
+
+    def test_chunked_pipeline_matches_one_chunk(self):
+        """Internal chunking (device-chained used-state) must agree with a
+        single-chunk solve of the same batch."""
+        rng = random.Random(31)
+        snapshot = random_cluster(rng, 30)
+        pods = random_pending(rng, 24)
+        fwk = default_fwk()
+        chunked, _ = TPUBackend(max_batch=8).assign(pods, snapshot, fwk)
+        whole, _ = TPUBackend(max_batch=24).assign(pods, snapshot, fwk)
+        assert chunked == whole
+
     def test_single_pod_picks_host_argmax(self):
         rng = random.Random(11)
         for trial in range(5):
