@@ -145,6 +145,31 @@ def parse_selector(s: str) -> Selector:
     return Selector(reqs)
 
 
+def selector_to_string(sel: Selector | None) -> str:
+    """Serialize a Selector back to the string grammar parse_selector reads
+    (the `labelSelector` query-parameter wire form)."""
+    if sel is None or not sel.requirements:
+        return ""
+    parts: list[str] = []
+    for r in sel.requirements:
+        if r.op == "Exists":
+            parts.append(r.key)
+        elif r.op == "DoesNotExist":
+            parts.append("!" + r.key)
+        elif r.op == "In" and len(r.values) == 1:
+            parts.append(f"{r.key}={r.values[0]}")
+        elif r.op == "NotIn" and len(r.values) == 1:
+            parts.append(f"{r.key}!={r.values[0]}")
+        elif r.op in ("In", "NotIn"):
+            parts.append(
+                f"{r.key} {'in' if r.op == 'In' else 'notin'} "
+                f"({','.join(r.values)})")
+        else:
+            raise ValueError(
+                f"operator {r.op!r} has no string-selector form")
+    return ",".join(parts)
+
+
 def match_node_selector_terms(
     terms: list | None,
     node_labels: Mapping[str, str],

@@ -1,0 +1,231 @@
+"""RemoteStore: the client-go analog — MVCCStore's interface over HTTP.
+
+Parity target: client-go `rest/request.go` + the clientset surface. Every
+in-process consumer (informers, controllers, the scheduler's DefaultBinder)
+takes a "store" duck-typed to MVCCStore; RemoteStore implements that duck
+type against an APIServer, so components gain a remote mode with no changes:
+
+- list/watch with label selectors, resourceVersion resume, 410 → Expired
+  (the informer's relist path), BOOKMARK frames
+- create/get/update/delete with kube Status error mapping
+- guaranteed_update as a client-side CAS retry loop
+  (client-go util/retry.RetryOnConflict)
+- subresource POST (binding)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import AsyncIterator, Callable, Mapping
+
+import aiohttp
+
+from kubernetes_tpu.api.labels import Selector, selector_to_string
+from kubernetes_tpu.api.meta import namespaced_name
+from kubernetes_tpu.store.mvcc import (
+    AlreadyExists,
+    Conflict,
+    Event,
+    Expired,
+    Invalid,
+    ListResult,
+    NotFound,
+    StoreError,
+)
+from kubernetes_tpu.apiserver.server import CLUSTER_SCOPED
+
+logger = logging.getLogger(__name__)
+
+_REASON_TO_EXC = {
+    "NotFound": NotFound,
+    "AlreadyExists": AlreadyExists,
+    "Conflict": Conflict,
+    "Invalid": Invalid,
+    "Expired": Expired,
+    "Gone": Expired,
+}
+
+
+def _raise_for_status(status: int, body: dict | str) -> None:
+    if status < 400:
+        return
+    reason, message = "", str(body)
+    if isinstance(body, dict):
+        reason = body.get("reason", "")
+        message = body.get("message", message)
+    exc = _REASON_TO_EXC.get(reason)
+    if exc is None:
+        exc = {404: NotFound, 409: Conflict, 410: Expired,
+               422: Invalid}.get(status, StoreError)
+    raise exc(message)
+
+
+class RemoteStore:
+    """MVCCStore-shaped client for an APIServer at `base_url`."""
+
+    def __init__(self, base_url: str, *, token: str | None = None,
+                 user_agent: str = "kubernetes-tpu-client"):
+        self.base_url = base_url.rstrip("/")
+        self._headers = {"User-Agent": user_agent}
+        if token:
+            self._headers["Authorization"] = f"Bearer {token}"
+        self._session: aiohttp.ClientSession | None = None
+
+    def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(headers=self._headers)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # alias so factory.stop()/store.stop() call sites can treat either store
+    def stop(self) -> None:
+        if self._session is not None and not self._session.closed:
+            asyncio.ensure_future(self._session.close())
+
+    # -- URL helpers -------------------------------------------------------
+
+    def _collection_url(self, resource: str, namespace: str | None) -> str:
+        if resource in CLUSTER_SCOPED or not namespace:
+            return f"{self.base_url}/api/v1/{resource}"
+        return f"{self.base_url}/api/v1/namespaces/{namespace}/{resource}"
+
+    def _item_url(self, resource: str, key: str) -> str:
+        if "/" in key:
+            ns, name = key.split("/", 1)
+            return (f"{self.base_url}/api/v1/namespaces/{ns}/"
+                    f"{resource}/{name}")
+        return f"{self.base_url}/api/v1/{resource}/{key}"
+
+    async def _json(self, resp: aiohttp.ClientResponse):
+        try:
+            body = await resp.json()
+        except (aiohttp.ContentTypeError, json.JSONDecodeError):
+            body = await resp.text()
+        _raise_for_status(resp.status, body)
+        return body
+
+    # -- CRUD --------------------------------------------------------------
+
+    async def create(self, resource: str, obj: Mapping, **_kw) -> dict:
+        ns = obj.get("metadata", {}).get("namespace")
+        async with self._sess().post(
+                self._collection_url(resource, ns), json=dict(obj)) as resp:
+            return await self._json(resp)
+
+    async def get(self, resource: str, key: str) -> dict:
+        async with self._sess().get(self._item_url(resource, key)) as resp:
+            return await self._json(resp)
+
+    async def update(self, resource: str, obj: Mapping, **_kw) -> dict:
+        key = namespaced_name(obj)
+        async with self._sess().put(
+                self._item_url(resource, key), json=dict(obj)) as resp:
+            return await self._json(resp)
+
+    async def delete(self, resource: str, key: str, *,
+                     uid: str | None = None) -> dict:
+        kwargs = {}
+        if uid:
+            kwargs["json"] = {"preconditions": {"uid": uid}}
+        async with self._sess().delete(
+                self._item_url(resource, key), **kwargs) as resp:
+            return await self._json(resp)
+
+    async def guaranteed_update(
+        self, resource: str, key: str,
+        mutate: Callable[[dict], dict | None],
+        max_retries: int = 16, return_copy: bool = True,
+    ) -> dict | None:
+        """Client-side CAS loop (util/retry.RetryOnConflict)."""
+        for _ in range(max_retries):
+            current = await self.get(resource, key)
+            want_rv = current["metadata"]["resourceVersion"]
+            updated = mutate(current)
+            if updated is None:
+                return current if return_copy else None
+            updated["metadata"]["resourceVersion"] = want_rv
+            try:
+                out = await self.update(resource, updated)
+                return out if return_copy else None
+            except Conflict:
+                continue
+        raise Conflict(
+            f"{resource} {key!r}: too many conflicts in guaranteed_update")
+
+    async def subresource(self, resource: str, key: str, sub: str,
+                          body: Mapping) -> dict:
+        url = self._item_url(resource, key) + "/" + sub
+        async with self._sess().post(url, json=dict(body)) as resp:
+            return await self._json(resp)
+
+    # -- LIST + WATCH ------------------------------------------------------
+
+    async def list(
+        self, resource: str, namespace: str | None = None,
+        selector: Selector | None = None, limit: int = 0,
+        continue_key: str | None = None,
+    ) -> ListResult:
+        params = {}
+        sel = selector_to_string(selector)
+        if sel:
+            params["labelSelector"] = sel
+        if limit:
+            params["limit"] = str(limit)
+        if continue_key:
+            params["continue"] = continue_key
+        async with self._sess().get(
+                self._collection_url(resource, namespace),
+                params=params) as resp:
+            body = await self._json(resp)
+        return ListResult(
+            items=body.get("items", []),
+            resource_version=int(
+                body.get("metadata", {}).get("resourceVersion", 0)))
+
+    async def watch(
+        self, resource: str, resource_version: int = 0,
+        namespace: str | None = None, selector: Selector | None = None,
+        **_kw,
+    ) -> AsyncIterator[Event]:
+        params = {"watch": "1"}
+        if resource_version:
+            params["resourceVersion"] = str(resource_version)
+        sel = selector_to_string(selector)
+        if sel:
+            params["labelSelector"] = sel
+        resp = await self._sess().get(
+            self._collection_url(resource, namespace), params=params,
+            timeout=aiohttp.ClientTimeout(total=None, sock_read=None))
+        if resp.status >= 400:
+            try:
+                body = await resp.json()
+            except (aiohttp.ContentTypeError, json.JSONDecodeError):
+                body = await resp.text()
+            resp.release()
+            _raise_for_status(resp.status, body)
+
+        async def gen() -> AsyncIterator[Event]:
+            try:
+                async for raw in resp.content:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    frame = json.loads(line)
+                    obj = frame.get("object") or {}
+                    rv = int(obj.get("metadata", {})
+                             .get("resourceVersion", 0) or 0)
+                    if frame.get("type") == "ERROR":
+                        reason = obj.get("reason", "")
+                        if reason in ("Expired", "Gone"):
+                            raise Expired(obj.get("message", "watch expired"))
+                        raise StoreError(obj.get("message", "watch error"))
+                    yield Event(frame["type"], obj, rv)
+            finally:
+                resp.release()
+
+        return gen()

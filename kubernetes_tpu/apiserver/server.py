@@ -1,0 +1,440 @@
+"""REST+JSON API server over MVCCStore.
+
+Parity targets:
+- staging/src/k8s.io/apiserver `pkg/server/config.go DefaultBuildHandlerChain`
+  → the aiohttp middleware stack (recovery → request-info → authn →
+  priority-and-fairness → audit), in the reference's order.
+- `pkg/endpoints/handlers/{create,get,watch,rest}.go` → the resource routes.
+- `pkg/util/flowcontrol` (APF) → `PriorityLevel` fair-queued seats: per-flow
+  FIFO queues drained round-robin into a bounded seat pool, 429 + Retry-After
+  on queue overflow (shuffle-shard omitted; flow = user-agent).
+- `pkg/registry/core/pod/storage/storage.go BindingREST.Create` → the
+  pods/binding subresource route.
+- watch wire: newline-delimited JSON WatchEvents with BOOKMARK frames and
+  `410 Gone` on expired resourceVersions (`pkg/storage/cacher`).
+
+Paths accept both core (`/api/v1/...`) and group (`/apis/<g>/<v>/...`)
+prefixes; resources map 1:1 onto store tables.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from collections import deque
+from typing import Mapping
+
+from aiohttp import web
+
+from kubernetes_tpu.api.labels import parse_selector
+from kubernetes_tpu.store.mvcc import (
+    AlreadyExists,
+    Conflict,
+    Expired,
+    Invalid,
+    MVCCStore,
+    NotFound,
+    StoreError,
+)
+
+logger = logging.getLogger(__name__)
+
+#: Resources without a namespace segment (everything else is namespaced).
+CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes"}
+
+
+def _status_body(code: int, reason: str, message: str) -> dict:
+    return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "code": code, "message": message}
+
+
+def _error_response(exc: StoreError) -> web.Response:
+    if isinstance(exc, NotFound):
+        code, reason = 404, "NotFound"
+    elif isinstance(exc, AlreadyExists):
+        code, reason = 409, "AlreadyExists"
+    elif isinstance(exc, Conflict):
+        code, reason = 409, "Conflict"
+    elif isinstance(exc, Invalid):
+        code, reason = 422, "Invalid"
+    elif isinstance(exc, Expired):
+        code, reason = 410, "Expired"
+    else:
+        code, reason = 500, "InternalError"
+    return web.json_response(_status_body(code, reason, str(exc)), status=code)
+
+
+class PriorityLevel:
+    """APF-lite: a seat pool with per-flow FIFO queues drained round-robin.
+
+    `seats` concurrent requests execute; excess requests wait in their
+    flow's queue (flow = client identity); when `queue_limit` waiters are
+    already parked for a flow, new arrivals are rejected (429) — the
+    reference's reject-when-queues-full behavior.
+    """
+
+    def __init__(self, name: str, seats: int = 16, queue_limit: int = 128):
+        self.name = name
+        self.seats = seats
+        self.queue_limit = queue_limit
+        self._in_use = 0
+        #: flow key -> deque of waiter futures
+        self._queues: dict[str, deque] = {}
+        #: round-robin order of flow keys with waiters
+        self._rr: deque[str] = deque()
+
+    @property
+    def in_use(self) -> int:
+        return self._in_use
+
+    @property
+    def queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    async def acquire(self, flow: str) -> None:
+        if self._in_use < self.seats and not self._rr:
+            self._in_use += 1
+            return
+        q = self._queues.get(flow)
+        if q is None:
+            q = self._queues[flow] = deque()
+            self._rr.append(flow)
+        if len(q) >= self.queue_limit:
+            raise web.HTTPTooManyRequests(
+                headers={"Retry-After": "1"},
+                text=json.dumps(_status_body(
+                    429, "TooManyRequests",
+                    f"priority level {self.name!r} queue full")),
+                content_type="application/json")
+        fut = asyncio.get_event_loop().create_future()
+        q.append(fut)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # release() handed us the seat in the same tick the task
+                # was cancelled — give it back or it leaks forever.
+                self.release()
+            else:
+                try:
+                    q.remove(fut)
+                except ValueError:
+                    pass
+            raise
+        # seat was transferred to us by release()
+
+    def release(self) -> None:
+        # Hand the seat to the next flow in round-robin order.
+        while self._rr:
+            flow = self._rr[0]
+            q = self._queues.get(flow)
+            if not q:
+                self._rr.popleft()
+                self._queues.pop(flow, None)
+                continue
+            fut = q.popleft()
+            self._rr.rotate(-1)
+            if not q:
+                try:
+                    self._rr.remove(flow)
+                except ValueError:
+                    pass
+                self._queues.pop(flow, None)
+            if not fut.done():
+                fut.set_result(None)
+                return  # seat transferred
+            # waiter was cancelled; try the next one
+        self._in_use -= 1
+
+
+class APIServer:
+    """Serve an MVCCStore over HTTP. One instance per "cluster"."""
+
+    def __init__(self, store: MVCCStore, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 priority_levels: Mapping[str, PriorityLevel] | None = None,
+                 bearer_tokens: Mapping[str, str] | None = None,
+                 metrics_registry=None,
+                 audit_log: bool = False):
+        self.store = store
+        self.host = host
+        self.port = port
+        #: route key → level. "system" catches lease/event traffic so node
+        #: heartbeats survive workload floods (the APF design goal).
+        self.priority_levels = dict(priority_levels or {
+            "system": PriorityLevel("system", seats=64),
+            "workload": PriorityLevel("workload", seats=32),
+        })
+        self.bearer_tokens = dict(bearer_tokens or {})  # token -> username
+        self.metrics_registry = metrics_registry
+        self.audit_log = audit_log
+        self._runner: web.AppRunner | None = None
+        self.app = self._build_app()
+
+    # -- handler chain (DefaultBuildHandlerChain order) --------------------
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(middlewares=[
+            self._mw_recovery,        # WithPanicRecovery
+            self._mw_request_info,    # WithRequestInfo
+            self._mw_authn,           # WithAuthentication
+            self._mw_priority,        # WithPriorityAndFairness
+            self._mw_audit,           # WithAudit
+        ])
+        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/readyz", self._healthz)
+        app.router.add_get("/metrics", self._metrics)
+        for prefix in ("/api/{version}", "/apis/{group}/{version}"):
+            # Namespaced routes first: "/api/v1/namespaces/ns/pods" must not
+            # be captured by the generic "{resource}/{name}/{subresource}".
+            app.router.add_route(
+                "*", prefix + "/namespaces/{namespace}/{resource}",
+                self._collection)
+            app.router.add_route(
+                "*", prefix + "/namespaces/{namespace}/{resource}/{name}",
+                self._item)
+            app.router.add_route(
+                "*",
+                prefix + "/namespaces/{namespace}/{resource}/{name}/{subresource}",
+                self._sub)
+            app.router.add_route(
+                "*", prefix + "/{resource}", self._collection)
+            app.router.add_route(
+                "*", prefix + "/{resource}/{name}", self._item)
+            app.router.add_route(
+                "*", prefix + "/{resource}/{name}/{subresource}", self._sub)
+        return app
+
+    @web.middleware
+    async def _mw_recovery(self, request: web.Request, handler):
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise
+        except StoreError as e:
+            return _error_response(e)
+        except asyncio.CancelledError:
+            raise
+        except (ValueError, json.JSONDecodeError) as e:
+            # Malformed client input (bad selector/limit/body JSON) is the
+            # client's fault: 400, not 500 (the reference's BadRequest).
+            return web.json_response(
+                _status_body(400, "BadRequest", str(e)), status=400)
+        except Exception:
+            logger.exception("panic in handler for %s", request.path)
+            return web.json_response(
+                _status_body(500, "InternalError", "internal error"),
+                status=500)
+
+    @web.middleware
+    async def _mw_request_info(self, request: web.Request, handler):
+        m = request.match_info
+        request["resource"] = m.get("resource", "")
+        request["namespace"] = m.get("namespace")
+        request["verb"] = {
+            "GET": "watch" if request.query.get("watch") else (
+                "get" if m.get("name") else "list"),
+            "POST": "create", "PUT": "update", "DELETE": "delete",
+            "PATCH": "patch",
+        }.get(request.method, request.method.lower())
+        return await handler(request)
+
+    @web.middleware
+    async def _mw_authn(self, request: web.Request, handler):
+        user = "system:anonymous"
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            token = auth[len("Bearer "):]
+            user = self.bearer_tokens.get(token)
+            if user is None:
+                if self.bearer_tokens:
+                    return web.json_response(
+                        _status_body(401, "Unauthorized", "invalid token"),
+                        status=401)
+                user = "system:anonymous"
+        request["user"] = user
+        return await handler(request)
+
+    def _classify(self, request: web.Request) -> PriorityLevel:
+        """Flow-schema-lite: leases + events + node status ride the system
+        level; everything else is workload."""
+        if request["resource"] in ("leases", "events"):
+            return self.priority_levels["system"]
+        return self.priority_levels["workload"]
+
+    @web.middleware
+    async def _mw_priority(self, request: web.Request, handler):
+        if request.path in ("/healthz", "/readyz", "/metrics"):
+            return await handler(request)
+        if request["verb"] == "watch":
+            return await handler(request)  # watches hold no seat (cacher)
+        level = self._classify(request)
+        flow = request.headers.get("User-Agent", "unknown")
+        await level.acquire(flow)
+        try:
+            return await handler(request)
+        finally:
+            level.release()
+
+    @web.middleware
+    async def _mw_audit(self, request: web.Request, handler):
+        resp = await handler(request)
+        if self.audit_log:
+            logger.info(
+                "audit user=%s verb=%s resource=%s ns=%s name=%s code=%s",
+                request.get("user"), request.get("verb"),
+                request.get("resource"), request.get("namespace"),
+                request.match_info.get("name"), resp.status)
+        return resp
+
+    # -- endpoints ---------------------------------------------------------
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        text = ""
+        if self.metrics_registry is not None:
+            text = self.metrics_registry.render()
+        return web.Response(text=text, content_type="text/plain")
+
+    @staticmethod
+    def _key(request: web.Request) -> str:
+        ns, name = request["namespace"], request.match_info["name"]
+        return f"{ns}/{name}" if ns else name
+
+    async def _collection(self, request: web.Request) -> web.StreamResponse:
+        resource = request["resource"]
+        if request.method == "GET":
+            if request.query.get("watch"):
+                return await self._watch(request)
+            sel = None
+            if request.query.get("labelSelector"):
+                sel = parse_selector(request.query["labelSelector"])
+            limit = int(request.query.get("limit", 0) or 0)
+            cont = request.query.get("continue")
+            lst = await self.store.list(
+                resource, namespace=request["namespace"], selector=sel,
+                limit=limit, continue_key=cont)
+            body = {
+                "kind": "List", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(lst.resource_version)},
+                "items": lst.items,
+            }
+            if limit and len(lst.items) >= limit:
+                # etcd-style continue token: the store key of the last item
+                # (store.list resumes strictly after continue_key).
+                last = lst.items[-1]["metadata"]
+                ns = last.get("namespace")
+                body["metadata"]["continue"] = \
+                    f"{ns}/{last['name']}" if ns else last["name"]
+            return web.json_response(body)
+        if request.method == "POST":
+            obj = await request.json()
+            if request["namespace"] and not obj.get(
+                    "metadata", {}).get("namespace"):
+                obj.setdefault("metadata", {})["namespace"] = \
+                    request["namespace"]
+            created = await self.store.create(resource, obj)
+            return web.json_response(created, status=201)
+        raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST"])
+
+    async def _item(self, request: web.Request) -> web.Response:
+        resource, key = request["resource"], self._key(request)
+        if request.method == "GET":
+            return web.json_response(await self.store.get(resource, key))
+        if request.method == "PUT":
+            obj = await request.json()
+            # The URL fully identifies the object; default the body's
+            # metadata from it so a sparse body can't target the wrong key.
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("name", request.match_info["name"])
+            if request["namespace"]:
+                meta.setdefault("namespace", request["namespace"])
+            return web.json_response(await self.store.update(resource, obj))
+        if request.method == "DELETE":
+            uid = None
+            if request.can_read_body:
+                try:
+                    body = await request.json()
+                    uid = (body.get("preconditions") or {}).get("uid")
+                except (ValueError, json.JSONDecodeError):
+                    pass
+            return web.json_response(
+                await self.store.delete(resource, key, uid=uid))
+        raise web.HTTPMethodNotAllowed(
+            request.method, ["GET", "PUT", "DELETE"])
+
+    async def _sub(self, request: web.Request) -> web.Response:
+        resource, key = request["resource"], self._key(request)
+        sub = request.match_info["subresource"]
+        if sub == "status" and request.method == "PUT":
+            obj = await request.json()
+            return web.json_response(await self.store.update(resource, obj))
+        if request.method != "POST":
+            raise web.HTTPMethodNotAllowed(request.method, ["POST"])
+        body = await request.json()
+        result = await self.store.subresource(resource, key, sub, body)
+        return web.json_response(result, status=201)
+
+    async def _watch(self, request: web.Request) -> web.StreamResponse:
+        """Chunked newline-delimited WatchEvents (the reference's
+        `Transfer-Encoding: chunked` watch stream)."""
+        resource = request["resource"]
+        rv = int(request.query.get("resourceVersion", 0) or 0)
+        sel = None
+        if request.query.get("labelSelector"):
+            sel = parse_selector(request.query["labelSelector"])
+        try:
+            watch = await self.store.watch(
+                resource, resource_version=rv,
+                namespace=request["namespace"], selector=sel)
+        except Expired as e:
+            return _error_response(e)
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "application/json;stream=watch"})
+        await resp.prepare(request)
+        try:
+            async for ev in watch:
+                if ev.type == "BOOKMARK":
+                    frame = {"type": "BOOKMARK", "object": {"metadata": {
+                        "resourceVersion": str(ev.rv)}}}
+                else:
+                    frame = {"type": ev.type, "object": ev.object}
+                await resp.write(
+                    json.dumps(frame, separators=(",", ":")).encode()
+                    + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            aclose = getattr(watch, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
+        return resp
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # Resolve the ephemeral port.
+        server = site._server  # noqa: SLF001
+        if server and server.sockets:
+            self.port = server.sockets[0].getsockname()[1]
+        logger.info("apiserver listening on %s:%d", self.host, self.port)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

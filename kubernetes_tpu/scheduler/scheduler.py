@@ -211,7 +211,7 @@ class Scheduler:
             pct = max(50 - num_nodes // 125, 5)
         return max(num_nodes * pct // 100, 100)
 
-    def find_nodes_that_fit(
+    async def find_nodes_that_fit(
         self, fwk: Framework, state: CycleState, pod: PodInfo, snapshot: Snapshot,
     ) -> tuple[list[NodeInfo], dict[str, Status]]:
         """findNodesThatFitPod: PreFilter → Filter each node (+ extenders)."""
@@ -244,15 +244,21 @@ class Scheduler:
                     break
             else:
                 statuses[node.name] = st
+        # findNodesThatPassExtenders: HTTP webhooks narrow the feasible set.
         for ext in self.extenders:
             if not feasible:
                 break
-            feasible, failed = ext.filter(pod, feasible)
+            feasible, failed, failed_unresolvable = \
+                await ext.filter(pod, feasible)
             for name, reason in failed.items():
-                statuses[name] = Status.unschedulable(reason).with_plugin(ext.name)
+                statuses[name] = Status.unschedulable(
+                    reason).with_plugin(ext.name)
+            for name, reason in failed_unresolvable.items():
+                statuses[name] = Status.unschedulable(
+                    reason, resolvable=False).with_plugin(ext.name)
         return feasible, statuses
 
-    def prioritize_nodes(
+    async def prioritize_nodes(
         self, fwk: Framework, state: CycleState, pod: PodInfo,
         nodes: list[NodeInfo],
     ) -> dict[str, float]:
@@ -260,9 +266,14 @@ class Scheduler:
         if not st.is_success():
             raise RuntimeError(f"PreScore error: {st.message()}")
         scores = fwk.run_scores(state, pod, nodes)
-        for ext in self.extenders:
-            for name, s in ext.prioritize(pod, nodes).items():
-                scores[name] = scores.get(name, 0.0) + s
+        if self.extenders:
+            # Parallel fan-out like extender.go's Prioritize goroutines;
+            # scores are summed so order doesn't matter.
+            results = await asyncio.gather(
+                *(ext.prioritize(pod, nodes) for ext in self.extenders))
+            for ext_scores in results:
+                for name, s in ext_scores.items():
+                    scores[name] = scores.get(name, 0.0) + s
         return scores
 
     def select_host(self, scores: Mapping[str, float]) -> str:
@@ -280,17 +291,18 @@ class Scheduler:
                     best = name
         return best or ""
 
-    def schedule_pod(self, fwk: Framework, state: CycleState, pod: PodInfo,
-                     snapshot: Snapshot) -> ScheduleResult:
+    async def schedule_pod(self, fwk: Framework, state: CycleState,
+                           pod: PodInfo, snapshot: Snapshot) -> ScheduleResult:
         if len(snapshot) == 0:
             raise FitError(pod, 0, {})
-        feasible, statuses = self.find_nodes_that_fit(fwk, state, pod, snapshot)
+        feasible, statuses = await self.find_nodes_that_fit(
+            fwk, state, pod, snapshot)
         if not feasible:
             raise FitError(pod, len(snapshot), statuses)
         if len(feasible) == 1:
             return ScheduleResult(feasible[0].name,
                                   len(statuses) + 1, 1)
-        scores = self.prioritize_nodes(fwk, state, pod, feasible)
+        scores = await self.prioritize_nodes(fwk, state, pod, feasible)
         host = self.select_host(scores)
         return ScheduleResult(host, len(statuses) + len(feasible), len(feasible))
 
@@ -315,7 +327,11 @@ class Scheduler:
 
     async def _schedule_pods(self, pods: list[PodInfo]) -> None:
         snapshot = self.cache.update_snapshot()
-        if self.backend is not None and len(pods) > 1:
+        # Extenders are per-pod HTTP webhooks whose round-trips dominate any
+        # batch win, and their filter verdicts must precede assignment — so
+        # configured extenders route pods through the (extender-aware) host
+        # path, exactly the reference's control flow.
+        if self.backend is not None and len(pods) > 1 and not self.extenders:
             # Pods are batched per profile: each batch runs under its own
             # plugin set/weights (profiles are keyed by schedulerName).
             by_profile: dict[str, list[PodInfo]] = {}
@@ -366,7 +382,7 @@ class Scheduler:
         state = CycleState()
         t0 = time.perf_counter()
         try:
-            result = self.schedule_pod(fwk, state, pi, snapshot)
+            result = await self.schedule_pod(fwk, state, pi, snapshot)
         except FitError as fe:
             self.metrics.observe_attempt("unschedulable", fwk.profile_name,
                                          time.perf_counter() - t0)
@@ -434,7 +450,7 @@ class Scheduler:
                 self.cache.forget_pod(pi.key)
                 await self._requeue_unschedulable(pi, st)
                 return
-            st = await fwk.run_bind(state, pi, node_name)
+            st = await self._bind(fwk, state, pi, node_name)
             if not st.is_success():
                 fwk.run_unreserve(state, pi, node_name)
                 self.cache.forget_pod(pi.key)
@@ -455,6 +471,20 @@ class Scheduler:
                 return
             self.cache.forget_pod(pi.key)
             await self.queue.move_to_backoff(pi)
+
+    async def _bind(self, fwk: Framework, state: CycleState, pi: PodInfo,
+                    node_name: str) -> Status:
+        """schedule_one.go bind: a bind-capable extender interested in the
+        pod binds INSTEAD of the framework's Bind plugins."""
+        for ext in self.extenders:
+            if getattr(ext, "is_binder", lambda: False)() \
+                    and ext.is_interested(pi):
+                try:
+                    await ext.bind(pi, node_name)
+                    return Status.success()
+                except Exception as e:
+                    return Status.error(f"extender bind failed: {e}")
+        return await fwk.run_bind(state, pi, node_name)
 
     # Permit wait support (gang scheduling parks here) ------------------
 
@@ -563,3 +593,10 @@ class Scheduler:
         for t in list(self._binding_tasks):
             t.cancel()
         await asyncio.gather(*self._binding_tasks, return_exceptions=True)
+        for ext in self.extenders:
+            close = getattr(ext, "close", None)
+            if close is not None:
+                try:
+                    await close()
+                except Exception:
+                    pass

@@ -1,0 +1,345 @@
+"""API server + RemoteStore: the §3.2 PROCESS BOUNDARY made real.
+
+The scheduler/informers/controllers consume the same duck-typed store
+interface; these tests run them against an APIServer over localhost sockets
+instead of the in-proc MVCCStore.
+"""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.apiserver import APIServer, PriorityLevel, RemoteStore
+from kubernetes_tpu.client import InformerFactory, ResourceEventHandler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.store.mvcc import (
+    AlreadyExists,
+    Conflict,
+    Expired,
+    MVCCStore,
+    NotFound,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _serve(store=None, **kw):
+    store = store or new_cluster_store()
+    install_core_validation(store)
+    srv = APIServer(store, **kw)
+    await srv.start()
+    return store, srv
+
+
+class TestCRUD:
+    def test_create_get_update_delete_roundtrip(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            created = await rs.create("pods", make_pod("a", "default"))
+            assert created["metadata"]["resourceVersion"]
+            got = await rs.get("pods", "default/a")
+            assert got["metadata"]["name"] == "a"
+            got["metadata"]["labels"] = {"app": "x"}
+            updated = await rs.update("pods", got)
+            assert updated["metadata"]["labels"] == {"app": "x"}
+            tomb = await rs.delete("pods", "default/a")
+            assert tomb["metadata"]["name"] == "a"
+            with pytest.raises(NotFound):
+                await rs.get("pods", "default/a")
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_error_mapping(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            await rs.create("pods", make_pod("a", "default"))
+            with pytest.raises(AlreadyExists):
+                await rs.create("pods", make_pod("a", "default"))
+            got = await rs.get("pods", "default/a")
+            got["metadata"]["resourceVersion"] = "999999"
+            with pytest.raises(Conflict):
+                await rs.update("pods", got)
+            with pytest.raises(NotFound):
+                await rs.get("pods", "default/nope")
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_binding_subresource_over_http(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            await rs.create("nodes", make_node("n1"))
+            await rs.create("pods", make_pod("a", "default"))
+            bound = await rs.subresource(
+                "pods", "default/a", "binding", {"target": {"name": "n1"}})
+            assert bound["spec"]["nodeName"] == "n1"
+            with pytest.raises(Conflict):
+                await rs.subresource(
+                    "pods", "default/a", "binding",
+                    {"target": {"name": "n2"}})
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_guaranteed_update_cas_loop(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            await rs.create("nodes", make_node("n1"))
+
+            async def bump(i):
+                def mut(n):
+                    n["metadata"].setdefault(
+                        "annotations", {})[f"w{i}"] = "1"
+                    return n
+                await rs.guaranteed_update("nodes", "n1", mut)
+            await asyncio.gather(*(bump(i) for i in range(6)))
+            got = await rs.get("nodes", "n1")
+            assert len(got["metadata"]["annotations"]) == 6
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+
+class TestListSemantics:
+    def test_limit_continue_pages_through(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            for i in range(7):
+                await rs.create("pods", make_pod(f"p{i}", "default"))
+            seen, cont = [], None
+            while True:
+                import aiohttp
+                params = {"limit": "3"}
+                if cont:
+                    params["continue"] = cont
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                            srv.url + "/api/v1/pods",
+                            params=params) as resp:
+                        body_ = await resp.json()
+                seen += [o["metadata"]["name"] for o in body_["items"]]
+                cont = body_["metadata"].get("continue")
+                if not cont:
+                    break
+            assert sorted(seen) == sorted(f"p{i}" for i in range(7))
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_malformed_selector_is_400(self):
+        async def body():
+            store, srv = await _serve()
+            import aiohttp
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        srv.url + "/api/v1/pods",
+                        params={"labelSelector": "bad(("}) as resp:
+                    assert resp.status == 400
+                    body_ = await resp.json()
+                    assert body_["reason"] == "BadRequest"
+            await srv.stop()
+            store.stop()
+        run(body())
+
+
+class TestWatch:
+    def test_list_watch_stream_and_selector(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            await rs.create("pods", make_pod(
+                "keep", "default", labels={"app": "web"}))
+            await rs.create("pods", make_pod(
+                "skip", "default", labels={"app": "db"}))
+            from kubernetes_tpu.api.labels import parse_selector
+            sel = parse_selector("app=web")
+            lst = await rs.list("pods", selector=sel)
+            assert [o["metadata"]["name"] for o in lst.items] == ["keep"]
+
+            watch = await rs.watch(
+                "pods", resource_version=lst.resource_version, selector=sel)
+            seen = []
+
+            async def consume():
+                async for ev in watch:
+                    seen.append((ev.type, ev.object["metadata"]["name"]))
+                    if len(seen) == 2:
+                        break
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.05)
+            await rs.create("pods", make_pod(
+                "keep2", "default", labels={"app": "web"}))
+            await rs.create("pods", make_pod(
+                "skip2", "default", labels={"app": "db"}))
+            await rs.delete("pods", "default/keep")
+            await asyncio.wait_for(task, 5)
+            assert seen == [("ADDED", "keep2"), ("DELETED", "keep")]
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_expired_rv_raises_410(self):
+        async def body():
+            small = MVCCStore(event_window=5)
+            install_core_validation(small)
+            srv = APIServer(small)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            for i in range(30):
+                await rs.create("pods", make_pod(f"p{i}", "default"))
+            with pytest.raises(Expired):
+                await rs.watch("pods", resource_version=2)
+            await rs.close()
+            await srv.stop()
+            small.stop()
+        run(body())
+
+    def test_informer_over_socket_syncs_and_recovers(self):
+        """The informer stack runs UNCHANGED against the remote store."""
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            for i in range(10):
+                await store.create("pods", make_pod(f"p{i}", "default"))
+            factory = InformerFactory(rs)
+            inf = factory.informer("pods")
+            adds = []
+            inf.add_event_handler(ResourceEventHandler(
+                on_add=lambda o: adds.append(o["metadata"]["name"])))
+            factory.start()
+            await factory.wait_for_sync()
+            assert len(adds) == 10
+            await store.create("pods", make_pod("live", "default"))
+            await asyncio.sleep(0.2)
+            assert "live" in adds
+            factory.stop()
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+
+class TestAPF:
+    def test_inflight_limit_queues_and_rejects(self):
+        async def body():
+            store = new_cluster_store()
+            gate = asyncio.Event()
+
+            # Stall every list so seats stay occupied.
+            orig_list = store.list
+
+            async def slow_list(resource, **kw):
+                await gate.wait()
+                return await orig_list(resource, **kw)
+            store.list = slow_list
+
+            srv = APIServer(store, priority_levels={
+                "system": PriorityLevel("system", seats=64),
+                "workload": PriorityLevel(
+                    "workload", seats=2, queue_limit=2),
+            })
+            await srv.start()
+            rs = RemoteStore(srv.url)
+
+            tasks = [asyncio.ensure_future(rs.list("pods"))
+                     for _ in range(4)]
+            await asyncio.sleep(0.1)
+            level = srv.priority_levels["workload"]
+            assert level.in_use == 2 and level.queued == 2
+            # Queue full → 429 mapped to StoreError by the client.
+            from kubernetes_tpu.store.mvcc import StoreError
+            with pytest.raises(StoreError):
+                await rs.list("pods")
+            gate.set()
+            await asyncio.gather(*tasks)
+            assert level.in_use == 0 and level.queued == 0
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_system_traffic_unaffected_by_workload_flood(self):
+        async def body():
+            store = new_cluster_store()
+            gate = asyncio.Event()
+            orig_list = store.list
+
+            async def slow_list(resource, **kw):
+                if resource == "pods":
+                    await gate.wait()
+                return await orig_list(resource, **kw)
+            store.list = slow_list
+            srv = APIServer(store, priority_levels={
+                "system": PriorityLevel("system", seats=4),
+                "workload": PriorityLevel("workload", seats=1,
+                                          queue_limit=8),
+            })
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            flood = [asyncio.ensure_future(rs.list("pods"))
+                     for _ in range(5)]
+            await asyncio.sleep(0.05)
+            # Leases ride the system level: unaffected by the pod flood.
+            got = await asyncio.wait_for(rs.list("leases"), 2)
+            assert got.items == []
+            gate.set()
+            await asyncio.gather(*flood)
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+
+class TestSchedulerOverSocket:
+    def test_scheduler_binds_pods_through_apiserver(self):
+        """End-to-end across the process boundary: informers LIST+WATCH over
+        HTTP, scheduler assigns, DefaultBinder POSTs the binding
+        subresource — the §3.1 bind POST for real."""
+        async def body():
+            from kubernetes_tpu.scheduler import Scheduler
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            for i in range(5):
+                await rs.create("nodes", make_node(
+                    f"n{i}", allocatable={"cpu": "8", "memory": "16Gi",
+                                          "pods": "110"}))
+            sched = Scheduler(rs)
+            factory = InformerFactory(rs)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            runner = asyncio.ensure_future(sched.run())
+            for i in range(20):
+                await rs.create("pods", make_pod(
+                    f"p{i}", "default",
+                    requests={"cpu": "100m", "memory": "128Mi"}))
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                lst = await rs.list("pods")
+                bound = [o for o in lst.items
+                         if o.get("spec", {}).get("nodeName")]
+                if len(bound) == 20:
+                    break
+            assert len(bound) == 20, f"only {len(bound)} bound"
+            await sched.stop()
+            runner.cancel()
+            factory.stop()
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())

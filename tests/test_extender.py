@@ -1,0 +1,198 @@
+"""Scheduler-extender seam over localhost HTTP (north-star seam #2).
+
+Parity target: pkg/scheduler/extender.go HTTPExtender + the config wire
+types. The demo ExtenderServer stands in for an out-of-process extender.
+"""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.extender import (
+    ExtenderError,
+    ExtenderServer,
+    HTTPExtender,
+)
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _cluster(n_nodes=4):
+    store = new_cluster_store()
+    install_core_validation(store)
+    for i in range(n_nodes):
+        await store.create("nodes", make_node(
+            f"n{i}", allocatable={"cpu": "8", "memory": "16Gi",
+                                  "pods": "110"}))
+    return store
+
+
+async def _run_scheduler(store, sched, n_pods):
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    runner = asyncio.ensure_future(sched.run())
+    for i in range(n_pods):
+        await store.create("pods", make_pod(
+            f"p{i}", "default", requests={"cpu": "100m"}))
+    bound = {}
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        lst = await store.list("pods")
+        bound = {o["metadata"]["name"]: o["spec"].get("nodeName")
+                 for o in lst.items if o.get("spec", {}).get("nodeName")}
+        if len(bound) == n_pods:
+            break
+    await sched.stop()
+    runner.cancel()
+    factory.stop()
+    return bound
+
+
+class TestExtenderVerbs:
+    def test_filter_narrows_feasible_set(self):
+        async def body():
+            ext_srv = ExtenderServer(
+                filter_fn=lambda pod, names: (
+                    [n for n in names if n == "n2"],
+                    {n: "extender says no" for n in names if n != "n2"}))
+            await ext_srv.start()
+            store = await _cluster()
+            sched = Scheduler(store)
+            sched.extenders = [HTTPExtender(
+                ext_srv.url, filter_verb="filter", name="demo")]
+            bound = await _run_scheduler(store, sched, 3)
+            assert set(bound.values()) == {"n2"}
+            verbs = [v for v, _ in ext_srv.requests]
+            assert "filter" in verbs
+            await ext_srv.stop()
+            store.stop()
+        run(body())
+
+    def test_prioritize_weighted_scores_steer_choice(self):
+        async def body():
+            ext_srv = ExtenderServer(
+                prioritize_fn=lambda pod, names: {"n1": 10})
+            await ext_srv.start()
+            store = await _cluster()
+            sched = Scheduler(store)
+            sched.extenders = [HTTPExtender(
+                ext_srv.url, prioritize_verb="prioritize", weight=100,
+                name="demo")]
+            bound = await _run_scheduler(store, sched, 3)
+            # weight 100 × score 10 swamps the in-tree scorers.
+            assert set(bound.values()) == {"n1"}
+            await ext_srv.stop()
+            store.stop()
+        run(body())
+
+    def test_bind_verb_replaces_default_binder(self):
+        async def body():
+            store = await _cluster()
+
+            def do_bind(args):
+                # The extender performs the actual binding (BindingREST).
+                async def _b():
+                    from kubernetes_tpu.store.mvcc import StoreError
+                    try:
+                        await store.subresource(
+                            "pods",
+                            f"{args['podNamespace']}/{args['podName']}",
+                            "binding", {"target": {"name": args["node"]}})
+                    except StoreError:
+                        pass
+                asyncio.ensure_future(_b())
+                return None
+            ext_srv = ExtenderServer(bind_fn=do_bind)
+            await ext_srv.start()
+            sched = Scheduler(store)
+            sched.extenders = [HTTPExtender(
+                ext_srv.url, bind_verb="bind", name="demo")]
+            bound = await _run_scheduler(store, sched, 3)
+            assert len(bound) == 3
+            assert [v for v, _ in ext_srv.requests].count("bind") == 3
+            await ext_srv.stop()
+            store.stop()
+        run(body())
+
+    def test_node_cache_capable_sends_names_only(self):
+        async def body():
+            ext_srv = ExtenderServer(
+                filter_fn=lambda pod, names: (names, {}))
+            await ext_srv.start()
+            store = await _cluster()
+            sched = Scheduler(store)
+            sched.extenders = [HTTPExtender(
+                ext_srv.url, filter_verb="filter",
+                node_cache_capable=True, name="demo")]
+            bound = await _run_scheduler(store, sched, 2)
+            assert len(bound) == 2
+            _, args = ext_srv.requests[0]
+            assert "nodenames" in args and "nodes" not in args
+            await ext_srv.stop()
+            store.stop()
+        run(body())
+
+
+class TestExtenderFailureModes:
+    def test_ignorable_extender_down_is_skipped(self):
+        async def body():
+            store = await _cluster()
+            sched = Scheduler(store)
+            sched.extenders = [HTTPExtender(
+                "http://127.0.0.1:1", filter_verb="filter",
+                ignorable=True, timeout=0.2, name="down")]
+            bound = await _run_scheduler(store, sched, 2)
+            assert len(bound) == 2  # scheduling proceeds without it
+            store.stop()
+        run(body())
+
+    def test_non_ignorable_extender_down_raises(self):
+        async def body():
+            ext = HTTPExtender("http://127.0.0.1:1", filter_verb="filter",
+                               timeout=0.2, name="down")
+            store = await _cluster(1)
+            lst = await store.list("nodes")
+            from kubernetes_tpu.scheduler.cache import SchedulerCache
+            cache = SchedulerCache()
+            for n in lst.items:
+                cache.add_node(n)
+            snap = cache.update_snapshot()
+            pod = PodInfo(make_pod("p", requests={"cpu": "1"}))
+            with pytest.raises(ExtenderError):
+                await ext.filter(pod, list(snap.nodes))
+            await ext.close()
+            store.stop()
+        run(body())
+
+    def test_managed_resources_gates_interest(self):
+        ext = HTTPExtender("http://x", filter_verb="filter",
+                           managed_resources=["example.com/gpu"])
+        plain = PodInfo(make_pod("p", requests={"cpu": "1"}))
+        gpu = PodInfo(make_pod("g", requests={"example.com/gpu": "1"}))
+        assert not ext.is_interested(plain)
+        assert ext.is_interested(gpu)
+
+    def test_from_config_parses_reference_yaml_shape(self):
+        cfg = {
+            "urlPrefix": "http://127.0.0.1:9999/scheduler",
+            "filterVerb": "filter", "prioritizeVerb": "prioritize",
+            "bindVerb": "bind", "weight": 5, "nodeCacheCapable": True,
+            "ignorable": True, "httpTimeout": "500ms",
+            "managedResources": [{"name": "example.com/gpu",
+                                  "ignoredByScheduler": True}],
+        }
+        ext = HTTPExtender.from_config(cfg)
+        assert ext.weight == 5
+        assert ext.node_cache_capable and ext.ignorable
+        assert ext.timeout == pytest.approx(0.5)
+        assert ext.managed_resources == {"example.com/gpu"}
+        assert ext.is_binder()
