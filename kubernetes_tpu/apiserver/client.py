@@ -16,6 +16,7 @@ type against an APIServer, so components gain a remote mode with no changes:
 from __future__ import annotations
 
 import asyncio
+import copy
 import json
 import logging
 from typing import AsyncIterator, Callable, Mapping
@@ -145,9 +146,12 @@ class RemoteStore:
         for _ in range(max_retries):
             current = await self.get(resource, key)
             want_rv = current["metadata"]["resourceVersion"]
+            pristine = copy.deepcopy(current) if return_copy else None
             updated = mutate(current)
             if updated is None:
-                return current if return_copy else None
+                # mutate may have scribbled on `current`; the pristine copy
+                # honors the "unchanged" contract without a second GET.
+                return pristine
             updated["metadata"]["resourceVersion"] = want_rv
             try:
                 out = await self.update(resource, updated)
@@ -211,7 +215,7 @@ class RemoteStore:
 
         async def gen() -> AsyncIterator[Event]:
             try:
-                async for raw in resp.content:
+                async for raw in _stream_lines(resp):
                     line = raw.strip()
                     if not line:
                         continue
@@ -225,7 +229,33 @@ class RemoteStore:
                             raise Expired(obj.get("message", "watch expired"))
                         raise StoreError(obj.get("message", "watch error"))
                     yield Event(frame["type"], obj, rv)
+            except (aiohttp.ClientError, ValueError) as e:
+                # Transport hiccups / oversized frames become a retriable
+                # StoreError so the informer relists instead of dying.
+                raise StoreError(f"watch stream error: {e}") from e
             finally:
                 resp.release()
 
         return gen()
+
+
+_MAX_FRAME = 64 << 20  # hard stop against a newline-free (corrupt) stream
+
+
+async def _stream_lines(resp: aiohttp.ClientResponse):
+    """Newline-split the watch stream from raw chunks, so a single frame
+    larger than the reader's line limit can't kill the watch."""
+    buf = bytearray()
+    async for chunk in resp.content.iter_any():
+        buf.extend(chunk)
+        if len(buf) > _MAX_FRAME:
+            raise ValueError(f"watch frame exceeded {_MAX_FRAME} bytes")
+        while True:
+            nl = buf.find(b"\n")
+            if nl < 0:
+                break
+            line = bytes(buf[:nl])
+            del buf[:nl + 1]
+            yield line
+    if buf:
+        yield bytes(buf)

@@ -371,7 +371,24 @@ class APIServer:
         sub = request.match_info["subresource"]
         if sub == "status" and request.method == "PUT":
             obj = await request.json()
-            return web.json_response(await self.store.update(resource, obj))
+            # The key comes from the URL; the subresource only replaces
+            # `.status` over the live object (the reference's StatusREST).
+            # A resourceVersion in the body is an optimistic-concurrency
+            # precondition: mismatch → 409, as with a full-object PUT.
+            status = obj.get("status", {})
+            want_rv = obj.get("metadata", {}).get("resourceVersion")
+
+            def merge_status(current: dict) -> dict:
+                if want_rv and \
+                        str(current["metadata"]["resourceVersion"]) != str(want_rv):
+                    raise Conflict(
+                        f"{resource} {key!r}: resourceVersion mismatch")
+                current["status"] = status
+                return current
+
+            out = await self.store.guaranteed_update(
+                resource, key, merge_status)
+            return web.json_response(out)
         if request.method != "POST":
             raise web.HTTPMethodNotAllowed(request.method, ["POST"])
         body = await request.json()

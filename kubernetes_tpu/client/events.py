@@ -54,13 +54,16 @@ class EventRecorder:
         )
         self._pending.append(ev)
         if not self._draining:
+            # Only create the drain coroutine when a loop is actually
+            # running — otherwise it would be dropped un-awaited and warn.
+            # With no loop (sync unit tests) the buffer flushes with the
+            # next event recorded under a loop.
             try:
-                asyncio.ensure_future(self._drain())
-                self._draining = True
+                asyncio.get_running_loop()
             except RuntimeError:
-                # No running loop (unit tests exercising sync paths): the
-                # buffer flushes with the next event recorded under a loop.
-                pass
+                return
+            asyncio.ensure_future(self._drain())
+            self._draining = True
 
     async def _drain(self) -> None:
         try:

@@ -700,7 +700,23 @@ class TPUBackend:
         # Host verify + working-state accumulation (hard part #1). The
         # verify context is shared across chunks, so later chunks are
         # checked against earlier chunks' accepted placements.
-        self._verify(pods, assign, ctx, run["stateful_pods"])
+        rejects = self._verify(pods, assign, ctx, run["stateful_pods"])
+
+        # Fold verify rejections back into the device-chained used-state so
+        # later chunks don't see the rejected pods' resources as consumed.
+        # Chunks already in flight were dispatched against the inflated
+        # state — conservative only (a reject can make a later in-flight pod
+        # look unschedulable; it just requeues). Adds commute, so
+        # subtracting from the CURRENT chained state is exact for every
+        # chunk dispatched after this point.
+        if rejects:
+            used = np.asarray(self._dev_used).copy()
+            r = batch.req_q.shape[1]
+            for i, idx in rejects:
+                used[idx, :r] -= batch.req_q[i]
+                used[idx, r:2 * r] -= batch.req_nz_q[i]
+                used[idx, 2 * r] -= 1
+            self._dev_used = self._put(used, "nodes_mat")
 
         # Lazy per-plugin diagnostics for unassigned pods.
         need_diag = [i for i, pi in enumerate(pods)
@@ -715,8 +731,11 @@ class TPUBackend:
 
     # -- verification --------------------------------------------------------
 
-    def _verify(self, pods, assign, ctx: "_AssignCtx", stateful_pods):
+    def _verify(self, pods, assign, ctx: "_AssignCtx", stateful_pods
+                ) -> list[tuple[int, int]]:
         """Post-solve verification (hard part #1: solve → verify → requeue).
+        Returns [(chunk index, node index)] for solver assignments the host
+        rejected, so the caller can fold them out of the device used-state.
 
         The batch-start masks are EXACT w.r.t. the snapshot (host rows use
         the host plugins; the tensorized affinity rows are differential-
@@ -762,6 +781,7 @@ class TPUBackend:
             "node(s) didn't have free ports for the requested pod ports"
         ).with_plugin("NodePorts")
 
+        rejects: list[tuple[int, int]] = []
         for i, pi in enumerate(pods):
             idx = int(assign[i])
             if idx < 0:
@@ -771,6 +791,7 @@ class TPUBackend:
             if insufficient_resources(pi, ni):
                 assignments[pi.key] = None
                 diagnostics[pi.key] = {ni.name: contention}
+                rejects.append((i, idx))
                 continue
             if pi.host_ports and any(
                     (ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip)
@@ -779,6 +800,7 @@ class TPUBackend:
                     for (uip, uproto, uport) in ni.used_ports):
                 assignments[pi.key] = None
                 diagnostics[pi.key] = {ni.name: port_conflict}
+                rejects.append((i, idx))
                 continue
             if full_check_batch:
                 # Non-IPA stateful plugins in play → full host re-check.
@@ -792,12 +814,14 @@ class TPUBackend:
                 if not st.is_success():
                     assignments[pi.key] = None
                     diagnostics[pi.key] = {ni.name: st}
+                    rejects.append((i, idx))
                     continue
             elif delta_has_terms or pi.has_affinity_constraints:
                 if not _delta_affinity_ok(pi, ni, delta, ct, compiler,
                                           sel_cache):
                     assignments[pi.key] = None
                     diagnostics[pi.key] = {ni.name: affinity_conflict}
+                    rejects.append((i, idx))
                     continue
             assignments[pi.key] = ni.name
             ni.add_pod(pi)
@@ -805,6 +829,7 @@ class TPUBackend:
             if pi.required_affinity_terms or pi.required_anti_affinity_terms:
                 delta_has_terms = True
         ctx.delta_has_terms = delta_has_terms
+        return rejects
 
     # -- explainability ------------------------------------------------------
 
