@@ -41,17 +41,31 @@ def main(argv=None) -> int:
                          "backend chunks + pipelines internally")
     ap.add_argument("--chunk", type=int, default=1024,
                     help="backend solve chunk (jit batch signature)")
+    ap.add_argument("--feature-gates", default="",
+                    help='e.g. "TPUScorer=true" — the north-star seam: the '
+                         "batched device backend hangs off this gate "
+                         "(--backend tpu is sugar for enabling it)")
     args = ap.parse_args(argv)
 
     from kubernetes_tpu.perf.scheduler_perf import PerfRunner
+    from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATES
+
+    # Backend selection goes through the TPUScorer feature gate (SURVEY
+    # §5.6 seam #3): CLI --backend only sets the gate's value.
+    DEFAULT_FEATURE_GATES.set("TPUScorer", args.backend == "tpu")
+    if args.feature_gates:
+        DEFAULT_FEATURE_GATES.set_from_spec(args.feature_gates)
 
     nodes, warmup, measured = PRESETS[args.preset]
     backend = None
     batch = 1
-    if args.backend == "tpu":
+    if DEFAULT_FEATURE_GATES.enabled("TPUScorer"):
         from kubernetes_tpu.ops import TPUBackend
         backend = TPUBackend(max_batch=args.chunk)
         batch = args.batch_size
+        args.backend = "tpu"
+    else:
+        args.backend = "host"
 
     # Warmup phase triggers jit compilation (first TPU compile is ~20-40s)
     # before the measured phase starts.

@@ -1,0 +1,15 @@
+from kubernetes_tpu.config.scheduler import (
+    ConfigError,
+    ProfileConfig,
+    SchedulerConfig,
+    build_scheduler,
+    load_config,
+)
+
+__all__ = [
+    "ConfigError",
+    "ProfileConfig",
+    "SchedulerConfig",
+    "build_scheduler",
+    "load_config",
+]

@@ -112,6 +112,9 @@ class Scheduler:
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.rng = random.Random(seed)
         self.backend = backend  # TPU batch backend; None = host path
+        #: Profiles the batched backend serves (TPUScorer gate, per-profile);
+        #: None = all profiles (constructor-injected backend, old behavior).
+        self.backend_profiles: set[str] | None = None
         self.extenders: list = []
         self.recorder = EventRecorder(store, "default-scheduler")
         self._informer_factory: InformerFactory | None = None
@@ -202,11 +205,14 @@ class Scheduler:
     # scheduling cycle (host path)
     # ------------------------------------------------------------------
 
-    def _num_feasible_nodes_to_find(self, num_nodes: int) -> int:
-        """numFeasibleNodesToFind: adaptive percentage sampling."""
-        if num_nodes < 100 or self.percentage_of_nodes_to_score >= 100:
+    def _num_feasible_nodes_to_find(self, num_nodes: int,
+                                    pct_override: int | None = None) -> int:
+        """numFeasibleNodesToFind: adaptive percentage sampling; a profile
+        may override the global percentage (reference scopes the field)."""
+        pct = self.percentage_of_nodes_to_score if pct_override is None \
+            else pct_override
+        if num_nodes < 100 or pct >= 100:
             return num_nodes
-        pct = self.percentage_of_nodes_to_score
         if pct <= 0:
             pct = max(50 - num_nodes // 125, 5)
         return max(num_nodes * pct // 100, 100)
@@ -230,7 +236,9 @@ class Scheduler:
             if ni is not None and fwk.run_filters(state, pod, ni).is_success():
                 return [ni], statuses
 
-        want = self._num_feasible_nodes_to_find(len(snapshot))
+        want = self._num_feasible_nodes_to_find(
+            len(snapshot),
+            getattr(fwk, "percentage_of_nodes_to_score", None))
         feasible: list[NodeInfo] = []
         # Round-robin start offset mirrors nextStartNodeIndex fairness.
         start = self.rng.randrange(len(snapshot)) if len(snapshot) else 0
@@ -333,16 +341,24 @@ class Scheduler:
         # path, exactly the reference's control flow.
         if self.backend is not None and len(pods) > 1 and not self.extenders:
             # Pods are batched per profile: each batch runs under its own
-            # plugin set/weights (profiles are keyed by schedulerName).
+            # plugin set/weights (profiles are keyed by schedulerName), and
+            # the TPUScorer gate selects the backend PER PROFILE
+            # (backend_profiles; None = all).
             by_profile: dict[str, list[PodInfo]] = {}
             for pi in pods:
                 by_profile.setdefault(pi.scheduler_name, []).append(pi)
             # The backend chunks to its own batch capacity internally and
             # PIPELINES the chunks (device state chains on device; chunk
             # k+1's solve overlaps chunk k's host verify) — SURVEY §2.8.
-            for group in by_profile.values():
-                await self._schedule_via_backend(group, snapshot)
-                snapshot = self.cache.update_snapshot()
+            for sname, group in by_profile.items():
+                if self.backend_profiles is None or \
+                        sname in self.backend_profiles:
+                    await self._schedule_via_backend(group, snapshot)
+                    snapshot = self.cache.update_snapshot()
+                else:
+                    for pi in group:
+                        await self._schedule_host_path(pi, snapshot)
+                        snapshot = self.cache.update_snapshot()
             return
         for pi in pods:
             await self._schedule_host_path(pi, snapshot)

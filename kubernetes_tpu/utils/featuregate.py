@@ -64,6 +64,14 @@ class FeatureGate:
     def known(self) -> dict[str, tuple[str, bool]]:
         return dict(self._known)
 
+    def clone(self) -> "FeatureGate":
+        """Independent copy — per-component gate resolution must not leak
+        into the process-wide defaults."""
+        g = FeatureGate()
+        g._known = dict(self._known)
+        g._enabled = dict(self._enabled)
+        return g
+
 
 #: Process-wide default gate set (kube_features.go `defaultKubernetesFeatureGates`).
 DEFAULT_FEATURE_GATES = FeatureGate()

@@ -1,0 +1,326 @@
+"""KubeSchedulerConfiguration loader + TPUScorer feature-gate wiring.
+
+Reference-shaped YAML (kubescheduler.config.k8s.io/v1, the exact field
+names of staging/src/k8s.io/kube-scheduler/config/v1) must load unchanged
+into running profiles; flipping `TPUScorer` must flip the backend.
+"""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.config import (
+    ConfigError,
+    build_scheduler,
+    load_config,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.utils.featuregate import ALPHA, FeatureGate
+
+
+def gates(**kw) -> FeatureGate:
+    g = FeatureGate()
+    g.add("TPUScorer", ALPHA, False)
+    g.add("TPUBatchSolver", ALPHA, False)
+    for k, v in kw.items():
+        g.set(k, v)
+    return g
+
+
+REFERENCE_YAML = """
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+parallelism: 8
+percentageOfNodesToScore: 40
+podInitialBackoffSeconds: 2
+podMaxBackoffSeconds: 20
+leaderElection:
+  leaderElect: true
+  leaseDuration: 15s
+  renewDeadline: 10s
+  retryPeriod: 2s
+profiles:
+- schedulerName: default-scheduler
+  plugins:
+    score:
+      disabled:
+      - name: ImageLocality
+      enabled:
+      - name: NodeResourcesBalancedAllocation
+        weight: 5
+  pluginConfig:
+  - name: NodeResourcesFit
+    args:
+      scoringStrategy:
+        type: MostAllocated
+        resources:
+        - name: cpu
+          weight: 2
+        - name: memory
+          weight: 1
+- schedulerName: gang-scheduler
+  plugins:
+    multiPoint:
+      enabled:
+      - name: Coscheduling
+    filter:
+      disabled:
+      - name: PodTopologySpread
+extenders:
+- urlPrefix: http://127.0.0.1:9999/scheduler
+  filterVerb: filter
+  prioritizeVerb: prioritize
+  weight: 2
+  nodeCacheCapable: true
+  ignorable: true
+  managedResources:
+  - name: example.com/foo
+    ignoredByScheduler: true
+"""
+
+
+class TestLoader:
+    def test_reference_yaml_loads_unchanged(self):
+        cfg = load_config(REFERENCE_YAML)
+        assert cfg.parallelism == 8
+        assert cfg.percentage_of_nodes_to_score == 40
+        assert cfg.pod_initial_backoff == 2
+        assert cfg.pod_max_backoff == 20
+        assert cfg.leader_elect and cfg.leader_lease_duration == 15.0
+        assert len(cfg.profiles) == 2
+        assert len(cfg.extenders) == 1
+
+        default = cfg.profiles[0]
+        assert default.scheduler_name == "default-scheduler"
+        assert "ImageLocality" not in default.active["Score"]
+        assert default.weights["NodeResourcesBalancedAllocation"] == 5
+        fit_args = default.plugin_config["NodeResourcesFit"]
+        assert fit_args["scoringStrategy"]["type"] == "MostAllocated"
+
+        gang = cfg.profiles[1]
+        assert "Coscheduling" in gang.active["Permit"]
+        assert "Coscheduling" in gang.active["PreEnqueue"]
+        assert "PodTopologySpread" not in gang.active["Filter"]
+        assert "PodTopologySpread" in gang.active["Score"]  # only Filter off
+
+    def test_frameworks_built_with_typed_args(self):
+        cfg = load_config(REFERENCE_YAML)
+        fwk = cfg.profiles[0].build_framework()
+        fit = next(p for p in fwk.score_plugins if p.NAME == "NodeResourcesFit")
+        assert fit.strategy_type == "MostAllocated"
+        assert fit.score_resources[0] == {"name": "cpu", "weight": 2}
+        assert all(p.NAME != "ImageLocality" for p in fwk.score_plugins)
+        assert fwk.score_weights["NodeResourcesBalancedAllocation"] == 5
+
+        gang = cfg.profiles[1].build_framework()
+        assert any(p.NAME == "Coscheduling" for p in gang.permit_plugins)
+        assert all(p.NAME != "PodTopologySpread" for p in gang.filter_plugins)
+        assert any(p.NAME == "PodTopologySpread" for p in gang.score_plugins)
+
+    def test_disable_star_clears_point(self):
+        cfg = load_config({
+            "profiles": [{"plugins": {
+                "score": {"disabled": [{"name": "*"}],
+                          "enabled": [{"name": "TaintToleration",
+                                       "weight": 7}]}}}],
+        })
+        prof = cfg.profiles[0]
+        assert prof.active["Score"] == ["TaintToleration"]
+        assert prof.weights["TaintToleration"] == 7
+        # Other points keep their defaults.
+        assert "NodeResourcesFit" in prof.active["Filter"]
+
+    def test_errors(self):
+        with pytest.raises(ConfigError):
+            load_config({"apiVersion": "nope/v1"})
+        with pytest.raises(ConfigError):
+            load_config({"kind": "Banana"})
+        with pytest.raises(ConfigError):
+            load_config({"profiles": [{"plugins": {
+                "filter": {"enabled": [{"name": "NoSuchPlugin"}]}}}]})
+        with pytest.raises(ConfigError):
+            load_config({"profiles": [
+                {"schedulerName": "a"}, {"schedulerName": "a"}]})
+        with pytest.raises(ConfigError):
+            # PrioritySort implements QueueSort, not Filter.
+            load_config({"profiles": [{"plugins": {
+                "filter": {"enabled": [{"name": "PrioritySort"}]}}}]})
+
+    def test_disable_star_multipoint_empties_everything(self):
+        cfg = load_config({"profiles": [{"plugins": {
+            "multiPoint": {"disabled": [{"name": "*"}]}}}]})
+        fwk = cfg.profiles[0].build_framework()
+        assert not fwk.plugins
+        assert not fwk.filter_plugins and not fwk.score_plugins
+
+    def test_per_profile_percentage_scoped(self):
+        store = new_cluster_store()
+        sched = build_scheduler(store, {
+            "percentageOfNodesToScore": 100,
+            "profiles": [
+                {"schedulerName": "a"},
+                {"schedulerName": "b", "percentageOfNodesToScore": 10},
+            ]}, feature_gates=gates())
+        assert sched._num_feasible_nodes_to_find(
+            5000, getattr(sched.profiles["a"],
+                          "percentage_of_nodes_to_score", None)) == 5000
+        assert sched._num_feasible_nodes_to_find(
+            5000, sched.profiles["b"].percentage_of_nodes_to_score) == 500
+        store.stop()
+
+    def test_config_gates_do_not_leak_between_builds(self):
+        g = gates()
+        store = new_cluster_store()
+        s1 = build_scheduler(store, {"featureGates": {"TPUScorer": True}},
+                             feature_gates=g)
+        s2 = build_scheduler(store, None, feature_gates=g)
+        assert s1.backend is not None
+        assert s2.backend is None, "gate leaked into the shared default set"
+        store.stop()
+
+    def test_unknown_feature_gate_tolerated(self):
+        store = new_cluster_store()
+        sched = build_scheduler(
+            store, {"featureGates": {"DynamicResourceAllocation": True}},
+            feature_gates=gates())
+        assert sched.backend is None
+        store.stop()
+
+    def test_load_from_file(self, tmp_path):
+        p = tmp_path / "sched.yaml"
+        p.write_text(REFERENCE_YAML)
+        cfg = load_config(str(p))
+        assert cfg.percentage_of_nodes_to_score == 40
+
+
+class TestTPUScorerGate:
+    def test_gate_off_means_host_path(self):
+        store = new_cluster_store()
+        sched = build_scheduler(store, None, feature_gates=gates())
+        assert sched.backend is None
+        store.stop()
+
+    def test_gate_on_selects_batched_backend(self):
+        from kubernetes_tpu.ops import TPUBackend
+        store = new_cluster_store()
+        sched = build_scheduler(store, None,
+                                feature_gates=gates(TPUScorer=True))
+        assert isinstance(sched.backend, TPUBackend)
+        assert sched.backend_profiles == {"default-scheduler"}
+        store.stop()
+
+    def test_config_feature_gates_key_flips_backend(self):
+        store = new_cluster_store()
+        sched = build_scheduler(
+            store, {"featureGates": {"TPUScorer": True}},
+            feature_gates=gates())
+        assert sched.backend is not None
+        store.stop()
+
+    def test_per_profile_override_removes_gate(self):
+        cfg = {
+            "profiles": [
+                {"schedulerName": "default-scheduler"},
+                {"schedulerName": "host-only",
+                 "pluginConfig": [{"name": "TPUScorer",
+                                   "args": {"enabled": False}}]},
+            ],
+        }
+        store = new_cluster_store()
+        sched = build_scheduler(store, cfg,
+                                feature_gates=gates(TPUScorer=True))
+        assert sched.backend_profiles == {"default-scheduler"}
+        store.stop()
+
+    def test_gate_on_schedules_through_backend_e2e(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(4):
+                await store.create("nodes", make_node(f"n{i}"))
+            sched = build_scheduler(store, None,
+                                    feature_gates=gates(TPUScorer=True),
+                                    seed=42)
+            calls = []
+            orig = sched.backend.assign_async
+
+            async def spy(pods, snapshot, fwk):
+                calls.append(len(pods))
+                return await orig(pods, snapshot, fwk)
+
+            sched.backend.assign_async = spy
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            for i in range(12):
+                await store.create("pods", make_pod(
+                    f"p{i}", requests={"cpu": "100m"}))
+            loop = asyncio.ensure_future(sched.run(batch_size=64))
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                pods = (await store.list("pods")).items
+                if sum(1 for p in pods if p["spec"].get("nodeName")) == 12:
+                    break
+            pods = (await store.list("pods")).items
+            assert sum(1 for p in pods if p["spec"].get("nodeName")) == 12
+            assert calls, "batched backend was never used with the gate on"
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        asyncio.run(body())
+
+    def test_mixed_profiles_route_by_gate(self):
+        """Pods of a host-only profile schedule via the host path while the
+        gated profile uses the backend — in one batch."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(3):
+                await store.create("nodes", make_node(f"n{i}"))
+            cfg = {
+                "profiles": [
+                    {"schedulerName": "default-scheduler"},
+                    {"schedulerName": "host-only",
+                     "pluginConfig": [{"name": "TPUScorer",
+                                       "args": {"enabled": False}}]},
+                ],
+            }
+            sched = build_scheduler(store, cfg,
+                                    feature_gates=gates(TPUScorer=True),
+                                    seed=42)
+            backend_pods = []
+            orig = sched.backend.assign_async
+
+            async def spy(pods, snapshot, fwk):
+                backend_pods.extend(p.key for p in pods)
+                return await orig(pods, snapshot, fwk)
+
+            sched.backend.assign_async = spy
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            for i in range(6):
+                await store.create("pods", make_pod(
+                    f"tpu{i}", requests={"cpu": "10m"}))
+                await store.create("pods", make_pod(
+                    f"host{i}", requests={"cpu": "10m"},
+                    scheduler_name="host-only"))
+            loop = asyncio.ensure_future(sched.run(batch_size=64))
+            for _ in range(120):
+                await asyncio.sleep(0.05)
+                pods = (await store.list("pods")).items
+                if sum(1 for p in pods if p["spec"].get("nodeName")) == 12:
+                    break
+            pods = (await store.list("pods")).items
+            assert sum(1 for p in pods if p["spec"].get("nodeName")) == 12
+            assert backend_pods and all("tpu" in k for k in backend_pods)
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        asyncio.run(body())
