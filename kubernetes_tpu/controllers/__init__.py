@@ -1,10 +1,15 @@
 """Controllers tier: reconcile loops over the store (SURVEY §2.4/§3.4)."""
 
 from kubernetes_tpu.controllers.base import Controller, ControllerManager
+from kubernetes_tpu.controllers.daemonset import (
+    DaemonSetController,
+    make_daemonset,
+)
 from kubernetes_tpu.controllers.deployment import (
     DeploymentController,
     make_deployment,
 )
+from kubernetes_tpu.controllers.job import JobController, make_job
 from kubernetes_tpu.controllers.kwok import KwokController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
@@ -12,10 +17,17 @@ from kubernetes_tpu.controllers.replicaset import (
     ReplicaSetController,
     make_replicaset,
 )
+from kubernetes_tpu.controllers.statefulset import (
+    StatefulSetController,
+    make_statefulset,
+)
 
 __all__ = [
     "Controller", "ControllerManager",
+    "DaemonSetController", "make_daemonset",
     "DeploymentController", "make_deployment",
+    "JobController", "make_job",
     "KwokController", "NodeLifecycleController", "PodGCController",
     "ReplicaSetController", "make_replicaset",
+    "StatefulSetController", "make_statefulset",
 ]

@@ -53,6 +53,22 @@ class Controller:
         factory.informer(resource).add_event_handler(ResourceEventHandler(
             on_add=enq, on_update=lambda old, new: enq(new), on_delete=enq))
 
+    def watch_owned_pods(self, factory: InformerFactory, kind: str) -> None:
+        """Pod events map back to the owning controller's key via the
+        controllerRef (the addPod/deletePod pattern every workload
+        controller shares)."""
+        def pod_to_owner(obj):
+            for ref in obj.get("metadata", {}).get("ownerReferences") or []:
+                if ref.get("controller") and ref.get("kind") == kind:
+                    ns = obj["metadata"].get("namespace", "default")
+                    asyncio.ensure_future(
+                        self.queue.add(f"{ns}/{ref['name']}"))
+                    return
+
+        factory.informer("pods").add_event_handler(ResourceEventHandler(
+            on_add=pod_to_owner, on_update=lambda o, n: pod_to_owner(n),
+            on_delete=pod_to_owner))
+
     async def enqueue(self, key: str) -> None:
         await self.queue.add(key)
 

@@ -38,6 +38,7 @@ class KwokController(Controller):
         self._managed: set[str] = set()
         self._run_queue: list[str] = []
         self._run_draining = False
+        self._stage_tasks: set[asyncio.Task] = set()
 
     def setup(self, factory: InformerFactory) -> None:
         self.pod_informer = factory.informer("pods")
@@ -115,6 +116,8 @@ class KwokController(Controller):
         return lease
 
     async def _mark_running(self, key: str) -> None:
+        complete_after = [None]
+
         def mutate(pod):
             if pod.get("status", {}).get("phase") != "Pending":
                 return None
@@ -122,6 +125,35 @@ class KwokController(Controller):
             conds = pod["status"].setdefault("conditions", [])
             if not any(c.get("type") == "Ready" for c in conds):
                 conds.append({"type": "Ready", "status": "True"})
+            complete_after[0] = (pod["metadata"].get("annotations") or {}).get(
+                "kwok.x-k8s.io/complete-after")
+            return pod
+        try:
+            await self.store.guaranteed_update(
+                "pods", key, mutate, return_copy=False)
+        except StoreError:
+            return
+        # Lifecycle stage (kwok Stage API analog): a pod annotated
+        # `kwok.x-k8s.io/complete-after: "<seconds>"` runs to completion —
+        # how Jobs finish in this kubelet-less world.
+        if complete_after[0] is not None:
+            try:
+                delay = float(complete_after[0])
+            except ValueError:
+                return
+            # Self-discarding set — one task per completing pod must not
+            # accumulate for the controller's lifetime.
+            t = asyncio.ensure_future(self._complete_later(key, delay))
+            self._stage_tasks.add(t)
+            t.add_done_callback(self._stage_tasks.discard)
+
+    async def _complete_later(self, key: str, delay: float) -> None:
+        await asyncio.sleep(delay)
+
+        def mutate(pod):
+            if pod.get("status", {}).get("phase") != "Running":
+                return None
+            pod["status"]["phase"] = "Succeeded"
             return pod
         try:
             await self.store.guaranteed_update(

@@ -1,0 +1,302 @@
+"""Job / DaemonSet / StatefulSet controllers — the workload tier
+(SURVEY §2.4 rows 44-46), incl. full chains through scheduler + kwok
+mirroring test_full_chain_deployment_to_running_pods."""
+
+import asyncio
+
+from kubernetes_tpu.api.meta import namespaced_name
+from kubernetes_tpu.api.types import make_node
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    DaemonSetController,
+    JobController,
+    KwokController,
+    StatefulSetController,
+    make_daemonset,
+    make_job,
+    make_statefulset,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.03):
+    for _ in range(int(timeout / interval)):
+        v = await predicate()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return await predicate()
+
+
+async def full_stack(controllers, node_count=3):
+    """store + kwok nodes + controllers + scheduler, all wired."""
+    store = new_cluster_store()
+    install_core_validation(store)
+    kwok = KwokController(store, node_count=node_count, lease_period=0.5)
+    await kwok.register_nodes()
+    mgr = ControllerManager(store, [c(store) for c in controllers] + [kwok])
+    await mgr.start()
+    sched = Scheduler(store, seed=7)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    sched_task = asyncio.ensure_future(sched.run())
+
+    async def teardown():
+        await sched.stop()
+        sched_task.cancel()
+        await mgr.stop()
+        factory.stop()
+        store.stop()
+    return store, kwok, teardown
+
+
+JOB_TEMPLATE = {
+    "metadata": {"labels": {"app": "batch"},
+                 "annotations": {"kwok.x-k8s.io/complete-after": "0.1"}},
+    "spec": {"containers": [{"name": "main", "image": "batch:v1",
+                             "resources": {"requests": {"cpu": "100m"}}}]},
+}
+
+
+class TestJob:
+    def test_parallelism_completions_to_complete(self):
+        """6 completions at parallelism 2: never more than 2 active, ends
+        Complete with succeeded=6."""
+        async def body():
+            store, kwok, teardown = await full_stack([JobController])
+            max_active = 0
+
+            async def poll():
+                nonlocal max_active
+                pods = (await store.list("pods")).items
+                active = sum(1 for p in pods
+                             if p["status"].get("phase") in ("Pending", "Running"))
+                max_active = max(max_active, active)
+                job = await store.get("jobs", "default/sum")
+                conds = (job.get("status") or {}).get("conditions") or []
+                return any(c["type"] == "Complete" and c["status"] == "True"
+                           for c in conds)
+
+            await store.create("jobs", make_job(
+                "sum", parallelism=2, completions=6, template=JOB_TEMPLATE))
+            assert await wait_for(poll, timeout=20.0)
+            job = await store.get("jobs", "default/sum")
+            assert job["status"]["succeeded"] == 6
+            assert job["status"]["active"] == 0
+            assert job["status"].get("completionTime")
+            assert max_active <= 2, f"parallelism exceeded: {max_active}"
+            await teardown()
+        run(body())
+
+    def test_indexed_mode_stable_identities(self):
+        async def body():
+            store, kwok, teardown = await full_stack([JobController])
+            await store.create("jobs", make_job(
+                "train", parallelism=4, completions=4,
+                completion_mode="Indexed", template=JOB_TEMPLATE))
+
+            async def complete():
+                job = await store.get("jobs", "default/train")
+                conds = (job.get("status") or {}).get("conditions") or []
+                return any(c["type"] == "Complete" for c in conds)
+            assert await wait_for(complete, timeout=20.0)
+            pods = (await store.list("pods")).items
+            names = {p["metadata"]["name"] for p in pods}
+            assert names == {"train-0", "train-1", "train-2", "train-3"}
+            idx = {p["metadata"]["annotations"]
+                   ["batch.kubernetes.io/job-completion-index"] for p in pods}
+            assert idx == {"0", "1", "2", "3"}
+            await teardown()
+        run(body())
+
+    def test_succeeded_count_survives_terminal_pod_deletion(self):
+        """GC/eviction deleting a finished pod must not regress
+        status.succeeded or re-run completed indexed work (cumulative
+        uncountedTerminatedPods semantics)."""
+        async def body():
+            store, kwok, teardown = await full_stack([JobController])
+            await store.create("jobs", make_job(
+                "persist", parallelism=1, completions=2,
+                completion_mode="Indexed", template=JOB_TEMPLATE))
+
+            async def first_done():
+                job = await store.get("jobs", "default/persist")
+                return (job["status"].get("succeeded") or 0) >= 1
+            assert await wait_for(first_done, timeout=20.0)
+            # Simulate PodGC: delete every Succeeded pod.
+            for p in (await store.list("pods")).items:
+                if p["status"].get("phase") == "Succeeded":
+                    await store.delete("pods", namespaced_name(p))
+
+            async def complete():
+                job = await store.get("jobs", "default/persist")
+                conds = (job.get("status") or {}).get("conditions") or []
+                return any(c["type"] == "Complete" for c in conds)
+            assert await wait_for(complete, timeout=20.0)
+            job = await store.get("jobs", "default/persist")
+            assert job["status"]["succeeded"] == 2
+            assert sorted(job["status"]["completedIndexes"]) == ["0", "1"]
+            await teardown()
+        run(body())
+
+    def test_backoff_limit_fails_job(self):
+        async def body():
+            store, kwok, teardown = await full_stack([JobController])
+            await store.create("jobs", make_job(
+                "doomed", parallelism=1, completions=3, backoff_limit=1,
+                template=JOB_TEMPLATE))
+
+            # Fail pods as they appear (kubelet-sim of a crashing container).
+            async def fail_pods():
+                pods = (await store.list("pods")).items
+                for p in pods:
+                    if p["status"].get("phase") in ("Pending", "Running"):
+                        def to_failed(obj):
+                            if obj["status"].get("phase") == "Succeeded":
+                                return None
+                            obj["status"]["phase"] = "Failed"
+                            return obj
+                        await store.guaranteed_update(
+                            "pods", namespaced_name(p), to_failed)
+                job = await store.get("jobs", "default/doomed")
+                conds = (job.get("status") or {}).get("conditions") or []
+                return any(c["type"] == "Failed" and
+                           c.get("reason") == "BackoffLimitExceeded"
+                           for c in conds)
+            assert await wait_for(fail_pods, timeout=20.0)
+            await teardown()
+        run(body())
+
+
+class TestDaemonSet:
+    def test_one_pod_per_node_via_node_affinity(self):
+        async def body():
+            store, kwok, teardown = await full_stack(
+                [DaemonSetController], node_count=4)
+            await store.create("daemonsets", make_daemonset(
+                "agent", {"matchLabels": {"app": "agent"}},
+                {"metadata": {"labels": {"app": "agent"}},
+                 "spec": {"containers": [{"name": "a", "image": "agent"}]}}))
+
+            async def all_running():
+                pods = (await store.list("pods")).items
+                return len(pods) == 4 and all(
+                    p["status"].get("phase") == "Running" for p in pods) \
+                    and pods
+            pods = await wait_for(all_running, timeout=15.0)
+            assert pods
+            # Scheduler placed each exactly on its pinned node (NodeAffinity
+            # matchFields metadata.name — the reference's post-1.12 path).
+            for p in pods:
+                terms = (p["spec"]["affinity"]["nodeAffinity"]
+                         ["requiredDuringSchedulingIgnoredDuringExecution"]
+                         ["nodeSelectorTerms"])
+                pinned = terms[0]["matchFields"][0]["values"][0]
+                assert p["spec"]["nodeName"] == pinned
+            nodes_covered = {p["spec"]["nodeName"] for p in pods}
+            assert len(nodes_covered) == 4
+            ds = await store.get("daemonsets", "default/agent")
+            assert ds["status"]["desiredNumberScheduled"] == 4
+            assert ds["status"]["numberReady"] == 4
+            await teardown()
+        run(body())
+
+    def test_new_node_gets_pod_and_node_selector_respected(self):
+        async def body():
+            store, kwok, teardown = await full_stack(
+                [DaemonSetController], node_count=2)
+            await store.create("daemonsets", make_daemonset(
+                "gpu-agent", {"matchLabels": {"app": "ga"}},
+                {"metadata": {"labels": {"app": "ga"}},
+                 "spec": {"nodeSelector": {"accel": "tpu"},
+                          "containers": [{"name": "a", "image": "agent"}]}}))
+            await asyncio.sleep(0.3)
+            assert (await store.list("pods")).items == []  # no node matches
+            node = make_node("kwok-node-99", labels={"accel": "tpu"})
+            await store.create("nodes", node)
+            kwok._managed.add("kwok-node-99")
+
+            async def one():
+                pods = (await store.list("pods")).items
+                return pods if len(pods) == 1 else None
+            pods = await wait_for(one, timeout=15.0)
+            assert pods and pods[0]["spec"].get("nodeName") == "kwok-node-99"
+            await teardown()
+        run(body())
+
+
+class TestStatefulSet:
+    def test_ordered_creation_and_identity(self):
+        async def body():
+            store, kwok, teardown = await full_stack([StatefulSetController])
+            await store.create("statefulsets", make_statefulset(
+                "db", 3, {"matchLabels": {"app": "db"}},
+                {"metadata": {"labels": {"app": "db"}},
+                 "spec": {"containers": [{"name": "d", "image": "db"}]}},
+                volume_claim_templates=[
+                    {"metadata": {"name": "data"},
+                     "spec": {"resources": {"requests": {"storage": "1Gi"}}}}]))
+
+            creation_order = []
+
+            async def all_up():
+                pods = (await store.list("pods")).items
+                for p in pods:
+                    if p["metadata"]["name"] not in creation_order:
+                        creation_order.append(p["metadata"]["name"])
+                return len(pods) == 3 and all(
+                    p["status"].get("phase") == "Running" for p in pods)
+            assert await wait_for(all_up, timeout=15.0)
+            # Ordinal names, ordered creation.
+            assert sorted(creation_order) == ["db-0", "db-1", "db-2"]
+            assert creation_order == ["db-0", "db-1", "db-2"]
+            pods = (await store.list("pods")).items
+            for p in pods:
+                assert p["metadata"]["labels"][
+                    "statefulset.kubernetes.io/pod-name"] == \
+                    p["metadata"]["name"]
+            # One PVC per pod from the claim template.
+            pvcs = (await store.list("persistentvolumeclaims")).items
+            assert {c["metadata"]["name"] for c in pvcs} == \
+                {"data-db-0", "data-db-1", "data-db-2"}
+            await teardown()
+        run(body())
+
+    def test_scale_down_removes_highest_ordinal_keeps_pvc(self):
+        async def body():
+            store, kwok, teardown = await full_stack([StatefulSetController])
+            await store.create("statefulsets", make_statefulset(
+                "db", 3, {"matchLabels": {"app": "db"}},
+                {"metadata": {"labels": {"app": "db"}},
+                 "spec": {"containers": [{"name": "d", "image": "db"}]}},
+                volume_claim_templates=[
+                    {"metadata": {"name": "data"},
+                     "spec": {"resources": {"requests": {"storage": "1Gi"}}}}]))
+
+            async def three():
+                pods = (await store.list("pods")).items
+                return len(pods) == 3 and all(
+                    p["status"].get("phase") == "Running" for p in pods)
+            assert await wait_for(three, timeout=15.0)
+            await store.guaranteed_update(
+                "statefulsets", "default/db",
+                lambda o: (o["spec"].__setitem__("replicas", 1), o)[1])
+
+            async def one():
+                pods = (await store.list("pods")).items
+                return len(pods) == 1 and pods
+            pods = await wait_for(one, timeout=15.0)
+            assert pods[0]["metadata"]["name"] == "db-0"
+            # PVCs survive scale-down (stable identity).
+            pvcs = (await store.list("persistentvolumeclaims")).items
+            assert len(pvcs) == 3
+            await teardown()
+        run(body())
