@@ -116,6 +116,56 @@ def make_node(
     return node
 
 
+def make_pv(name: str, capacity: str = "10Gi", *,
+            storage_class: str = "", access_modes: list | None = None,
+            node_affinity: Mapping | None = None,
+            labels: Mapping[str, str] | None = None,
+            reclaim_policy: str = "Retain") -> dict:
+    """core/v1 PersistentVolume (cluster-scoped). `node_affinity` is the
+    PV's `spec.nodeAffinity.required` nodeSelectorTerms mapping (topology
+    pinning — local/zonal volumes)."""
+    spec = {
+        "capacity": {"storage": capacity},
+        "accessModes": access_modes or ["ReadWriteOnce"],
+        "storageClassName": storage_class,
+        "persistentVolumeReclaimPolicy": reclaim_policy,
+    }
+    if node_affinity:
+        spec["nodeAffinity"] = {"required": dict(node_affinity)}
+    pv = new_object("PersistentVolume", name, None, spec=spec,
+                    status={"phase": "Available"})
+    if labels:
+        pv["metadata"]["labels"] = dict(labels)
+    return pv
+
+
+def make_pvc(name: str, namespace: str = "default", request: str = "1Gi", *,
+             storage_class: str | None = None,
+             access_modes: list | None = None) -> dict:
+    spec = {
+        "resources": {"requests": {"storage": request}},
+        "accessModes": access_modes or ["ReadWriteOnce"],
+    }
+    if storage_class is not None:
+        spec["storageClassName"] = storage_class
+    return new_object("PersistentVolumeClaim", name, namespace, spec=spec,
+                      status={"phase": "Pending"})
+
+
+def make_storage_class(name: str, *,
+                       binding_mode: str = "Immediate",
+                       provisioner: str = "ktpu.dev/simulated",
+                       allowed_topologies: list | None = None) -> dict:
+    """storage.k8s.io/v1 StorageClass; `binding_mode` is
+    Immediate | WaitForFirstConsumer."""
+    sc = new_object("StorageClass", name, None)
+    sc["volumeBindingMode"] = binding_mode
+    sc["provisioner"] = provisioner
+    if allowed_topologies:
+        sc["allowedTopologies"] = allowed_topologies
+    return sc
+
+
 def make_binding(pod: Mapping, node_name: str) -> dict:
     """core/v1 Binding: target node for a pod; POSTed to the pod's /binding
     subresource (pkg/registry/core/pod/storage `BindingREST.Create`)."""

@@ -41,7 +41,7 @@ from kubernetes_tpu.store.mvcc import (
 logger = logging.getLogger(__name__)
 
 #: Resources without a namespace segment (everything else is namespaced).
-CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes"}
+CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses"}
 
 
 def _status_body(code: int, reason: str, message: str) -> dict:

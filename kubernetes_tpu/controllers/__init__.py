@@ -13,6 +13,7 @@ from kubernetes_tpu.controllers.job import JobController, make_job
 from kubernetes_tpu.controllers.kwok import KwokController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.pvbinder import PVBinderController
 from kubernetes_tpu.controllers.replicaset import (
     ReplicaSetController,
     make_replicaset,
@@ -28,6 +29,7 @@ __all__ = [
     "DeploymentController", "make_deployment",
     "JobController", "make_job",
     "KwokController", "NodeLifecycleController", "PodGCController",
+    "PVBinderController",
     "ReplicaSetController", "make_replicaset",
     "StatefulSetController", "make_statefulset",
 ]

@@ -78,6 +78,9 @@ _FILTER_ACTIVE = {
     "PodTopologySpread": lambda plugin, pi, snap: bool(
         plugin._constraints_for(pi, "DoNotSchedule")),
     "NodePorts": lambda plugin, pi, snap: bool(pi.host_ports),
+    "VolumeBinding": lambda plugin, pi, snap: bool(pi.pvc_names),
+    "VolumeZone": lambda plugin, pi, snap: bool(pi.pvc_names),
+    "NodeVolumeLimits": lambda plugin, pi, snap: bool(pi.pvc_names),
 }
 _SCORE_ACTIVE = {
     "InterPodAffinity": lambda plugin, pi, snap: bool(

@@ -25,6 +25,11 @@ from kubernetes_tpu.scheduler.plugins.noderesources import (
 )
 from kubernetes_tpu.scheduler.plugins.coscheduling import Coscheduling
 from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.scheduler.plugins.volumebinding import (
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeZone,
+)
 
 #: name -> factory(args) (framework/runtime Registry). Coscheduling is
 #: registered but not default-enabled (out-of-tree in the reference).
@@ -39,6 +44,9 @@ IN_TREE: dict[str, Callable] = {
     "NodeUnschedulable": NodeUnschedulable,
     "TaintToleration": TaintToleration,
     "NodePorts": NodePorts,
+    "VolumeBinding": VolumeBinding,
+    "VolumeZone": VolumeZone,
+    "NodeVolumeLimits": NodeVolumeLimits,
     "InterPodAffinity": InterPodAffinity,
     "PodTopologySpread": PodTopologySpread,
     "ImageLocality": ImageLocality,
@@ -55,6 +63,9 @@ DEFAULT_PLUGINS = [
     "TaintToleration",
     "NodeAffinity",
     "NodePorts",
+    "VolumeBinding",
+    "VolumeZone",
+    "NodeVolumeLimits",
     "NodeResourcesFit",
     "NodeResourcesBalancedAllocation",
     "InterPodAffinity",

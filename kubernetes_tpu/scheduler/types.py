@@ -83,7 +83,7 @@ class PodInfo:
         "node_name", "scheduler_name",
         "node_selector", "affinity", "tolerations",
         "topology_spread_constraints", "scheduling_gates",
-        "host_ports",
+        "host_ports", "pvc_names",
         "required_affinity_terms", "required_anti_affinity_terms",
         "preferred_affinity_terms", "preferred_anti_affinity_terms",
         "attempts", "last_failure", "unschedulable_plugins", "queued_at",
@@ -109,6 +109,10 @@ class PodInfo:
         self.topology_spread_constraints = spec.get("topologySpreadConstraints") or []
         self.scheduling_gates = [g.get("name") for g in spec.get("schedulingGates") or []]
         self.host_ports = pod_host_ports(pod)
+        self.pvc_names = [
+            v["persistentVolumeClaim"]["claimName"]
+            for v in spec.get("volumes") or []
+            if v.get("persistentVolumeClaim", {}).get("claimName")]
         pod_aff = self.affinity.get("podAffinity") or {}
         pod_anti = self.affinity.get("podAntiAffinity") or {}
         self.required_affinity_terms = list(

@@ -1,0 +1,257 @@
+"""Volume family: PV binder controller + VolumeBinding/VolumeZone/
+NodeVolumeLimits plugins (SURVEY §2.3 volumebinding/, §2.4 pv_controller).
+
+The headline e2e (VERDICT r2 #3): a pod with an unbound
+WaitForFirstConsumer PVC schedules only after PreBind's blocking
+provisioning; Unreserve releases the claim plan on failure.
+"""
+
+import asyncio
+
+from kubernetes_tpu.api.types import (
+    make_node,
+    make_pod,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+)
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.controllers import ControllerManager, PVBinderController
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.03):
+    for _ in range(int(timeout / interval)):
+        v = await predicate()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return await predicate()
+
+
+def pod_with_pvc(name, claim, **kw):
+    pod = make_pod(name, requests={"cpu": "100m"}, **kw)
+    pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": claim}}]
+    return pod
+
+
+async def volume_stack(nodes=None):
+    store = new_cluster_store()
+    install_core_validation(store)
+    for n in nodes or [make_node(f"n{i}") for i in range(3)]:
+        await store.create("nodes", n)
+    mgr = ControllerManager(store, [PVBinderController(store)])
+    await mgr.start()
+    sched = Scheduler(store, seed=11)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    task = asyncio.ensure_future(sched.run())
+
+    async def teardown():
+        await sched.stop()
+        task.cancel()
+        await mgr.stop()
+        factory.stop()
+        store.stop()
+    return store, sched, teardown
+
+
+class TestPVBinder:
+    def test_immediate_binding_static_pv(self):
+        async def body():
+            store, sched, teardown = await volume_stack()
+            await store.create("persistentvolumes", make_pv("pv-a", "10Gi"))
+            await store.create("persistentvolumeclaims", make_pvc(
+                "data", request="5Gi"))
+
+            async def bound():
+                pvc = await store.get("persistentvolumeclaims", "default/data")
+                return pvc["status"].get("phase") == "Bound" and \
+                    pvc["spec"].get("volumeName") == "pv-a"
+            assert await wait_for(bound)
+            pv = await store.get("persistentvolumes", "pv-a")
+            assert pv["status"]["phase"] == "Bound"
+            assert pv["spec"]["claimRef"]["name"] == "data"
+            await teardown()
+        run(body())
+
+    def test_capacity_and_class_matching(self):
+        async def body():
+            store, sched, teardown = await volume_stack()
+            await store.create("persistentvolumes", make_pv("small", "1Gi"))
+            await store.create("persistentvolumes", make_pv(
+                "classed", "20Gi", storage_class="fast"))
+            await store.create("persistentvolumes", make_pv("big", "20Gi"))
+            await store.create("persistentvolumeclaims", make_pvc(
+                "data", request="5Gi"))
+
+            async def bound():
+                pvc = await store.get("persistentvolumeclaims", "default/data")
+                return pvc["spec"].get("volumeName")
+            vol = await wait_for(bound)
+            assert vol == "big"  # capacity too small / class mismatch skipped
+            await teardown()
+        run(body())
+
+    def test_pvc_delete_releases_pv(self):
+        async def body():
+            store, sched, teardown = await volume_stack()
+            await store.create("persistentvolumes", make_pv("pv-a", "10Gi"))
+            await store.create("persistentvolumeclaims", make_pvc("data"))
+
+            async def bound():
+                pv = await store.get("persistentvolumes", "pv-a")
+                return pv["status"].get("phase") == "Bound"
+            assert await wait_for(bound)
+            await store.delete("persistentvolumeclaims", "default/data")
+
+            async def released():
+                pv = await store.get("persistentvolumes", "pv-a")
+                return pv["status"].get("phase") == "Available" and \
+                    not pv["spec"].get("claimRef")
+            assert await wait_for(released)
+            await teardown()
+        run(body())
+
+
+class TestVolumeBindingE2E:
+    def test_wffc_pod_schedules_after_blocking_provision(self):
+        """The VERDICT done-criterion: unbound WFFC PVC; the pod's PreBind
+        writes selected-node and blocks; the PV controller provisions a PV
+        pinned to that node; only then does the pod bind."""
+        async def body():
+            store, sched, teardown = await volume_stack()
+            await store.create("storageclasses", make_storage_class(
+                "wffc", binding_mode="WaitForFirstConsumer"))
+            await store.create("persistentvolumeclaims", make_pvc(
+                "data", storage_class="wffc"))
+            await store.create("pods", pod_with_pvc("app", "data"))
+
+            async def pod_bound():
+                pod = await store.get("pods", "default/app")
+                return pod["spec"].get("nodeName")
+            node = await wait_for(pod_bound, timeout=15.0)
+            assert node
+            pvc = await store.get("persistentvolumeclaims", "default/data")
+            assert pvc["status"]["phase"] == "Bound"
+            ann = pvc["metadata"]["annotations"][
+                "volume.kubernetes.io/selected-node"]
+            assert ann == node
+            # The provisioned PV is topology-pinned to the selected node.
+            pv = await store.get("persistentvolumes",
+                                 pvc["spec"]["volumeName"])
+            terms = pv["spec"]["nodeAffinity"]["required"][
+                "nodeSelectorTerms"]
+            assert terms[0]["matchFields"][0]["values"] == [node]
+            await teardown()
+        run(body())
+
+    def test_bound_pv_node_affinity_constrains_scheduling(self):
+        """A pre-bound local PV pinned to n1 forces the pod onto n1."""
+        async def body():
+            store, sched, teardown = await volume_stack()
+            pv = make_pv("local-pv", "10Gi", node_affinity={
+                "nodeSelectorTerms": [{"matchFields": [
+                    {"key": "metadata.name", "operator": "In",
+                     "values": ["n1"]}]}]})
+            await store.create("persistentvolumes", pv)
+            await store.create("persistentvolumeclaims", make_pvc("data"))
+
+            async def pvc_bound():
+                c = await store.get("persistentvolumeclaims", "default/data")
+                return c["status"].get("phase") == "Bound"
+            assert await wait_for(pvc_bound)
+            await store.create("pods", pod_with_pvc("app", "data"))
+
+            async def pod_bound():
+                pod = await store.get("pods", "default/app")
+                return pod["spec"].get("nodeName")
+            node = await wait_for(pod_bound, timeout=15.0)
+            assert node == "n1"
+            await teardown()
+        run(body())
+
+    def test_missing_pvc_is_unschedulable(self):
+        async def body():
+            store, sched, teardown = await volume_stack()
+            await store.create("pods", pod_with_pvc("app", "nope"))
+            await asyncio.sleep(0.5)
+            pod = await store.get("pods", "default/app")
+            assert not pod["spec"].get("nodeName")
+            assert sched.queue.stats()["unschedulable"] == 1
+            await teardown()
+        run(body())
+
+    def test_no_provisioner_class_blocks_until_pv_appears(self):
+        """WFFC + no-provisioner (local volumes): pod stays pending until a
+        matching PV exists, then schedules onto the PV's node."""
+        async def body():
+            store, sched, teardown = await volume_stack()
+            await store.create("storageclasses", make_storage_class(
+                "local", binding_mode="WaitForFirstConsumer",
+                provisioner="kubernetes.io/no-provisioner"))
+            await store.create("persistentvolumeclaims", make_pvc(
+                "data", storage_class="local"))
+            await store.create("pods", pod_with_pvc("app", "data"))
+            await asyncio.sleep(0.5)
+            pod = await store.get("pods", "default/app")
+            assert not pod["spec"].get("nodeName")
+            # A local PV on n2 appears; Node/Add-ish event requeues via
+            # the 60s flush or PV informers — poke with a node update.
+            pv = make_pv("local-1", "10Gi", storage_class="local",
+                         node_affinity={"nodeSelectorTerms": [{
+                             "matchFields": [{"key": "metadata.name",
+                                              "operator": "In",
+                                              "values": ["n2"]}]}]})
+            await store.create("persistentvolumes", pv)
+            await sched.queue.move_all(
+                __import__("kubernetes_tpu.scheduler.queue",
+                           fromlist=["ClusterEvent"]).ClusterEvent(
+                               "Node", "Update"))
+
+            async def pod_bound():
+                p = await store.get("pods", "default/app")
+                return p["spec"].get("nodeName")
+            node = await wait_for(pod_bound, timeout=15.0)
+            assert node == "n2"
+            await teardown()
+        run(body())
+
+
+class TestVolumeLimits:
+    def test_node_volume_limits_filter(self):
+        async def body():
+            node = make_node("tiny")
+            node["status"]["allocatable"]["attachable-volumes-csi"] = "1"
+            store, sched, teardown = await volume_stack(nodes=[node])
+            for i in range(2):
+                await store.create("persistentvolumes",
+                                   make_pv(f"pv{i}", "10Gi"))
+                await store.create("persistentvolumeclaims",
+                                   make_pvc(f"c{i}"))
+
+            async def claims_bound():
+                cs = (await store.list("persistentvolumeclaims")).items
+                return all(c["status"].get("phase") == "Bound" for c in cs)
+            assert await wait_for(claims_bound)
+            await store.create("pods", pod_with_pvc("p0", "c0"))
+
+            async def first():
+                p = await store.get("pods", "default/p0")
+                return p["spec"].get("nodeName")
+            assert await wait_for(first, timeout=15.0)
+            await store.create("pods", pod_with_pvc("p1", "c1"))
+            await asyncio.sleep(0.6)
+            p1 = await store.get("pods", "default/p1")
+            assert not p1["spec"].get("nodeName"), \
+                "second volume exceeded the node's attach limit"
+            await teardown()
+        run(body())
