@@ -155,14 +155,20 @@ def make_pvc(name: str, namespace: str = "default", request: str = "1Gi", *,
 def make_storage_class(name: str, *,
                        binding_mode: str = "Immediate",
                        provisioner: str = "ktpu.dev/simulated",
-                       allowed_topologies: list | None = None) -> dict:
+                       allowed_topologies: list | None = None,
+                       is_default: bool = False) -> dict:
     """storage.k8s.io/v1 StorageClass; `binding_mode` is
-    Immediate | WaitForFirstConsumer."""
+    Immediate | WaitForFirstConsumer. `is_default` sets the
+    storageclass.kubernetes.io/is-default-class annotation the
+    DefaultStorageClass admission mutator looks for."""
     sc = new_object("StorageClass", name, None)
     sc["volumeBindingMode"] = binding_mode
     sc["provisioner"] = provisioner
     if allowed_topologies:
         sc["allowedTopologies"] = allowed_topologies
+    if is_default:
+        sc["metadata"].setdefault("annotations", {})[
+            "storageclass.kubernetes.io/is-default-class"] = "true"
     return sc
 
 

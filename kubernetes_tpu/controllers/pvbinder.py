@@ -195,7 +195,7 @@ class PVBinderController(Controller):
             if not ref or (claim_uid and ref.get("uid") != claim_uid):
                 return None
             if obj["spec"].get("persistentVolumeReclaimPolicy") == "Delete":
-                obj["status"]["phase"] = "Released"  # then deleted below
+                obj.setdefault("status", {})["phase"] = "Released"  # then deleted below
             else:
                 obj["spec"].pop("claimRef", None)
                 obj.setdefault("status", {})["phase"] = "Available"

@@ -15,13 +15,13 @@ import asyncio
 import logging
 
 from kubernetes_tpu.api.meta import namespaced_name
+from kubernetes_tpu.controllers.pvbinder import SELECTED_NODE_ANN
 from kubernetes_tpu.scheduler.framework import CycleState, Plugin, Status
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
 from kubernetes_tpu.store.mvcc import StoreError
 
 logger = logging.getLogger(__name__)
 
-SELECTED_NODE_ANN = "volume.kubernetes.io/selected-node"
 _STATE_KEY = "VolumeBinding/claims"
 ZONE_LABELS = ("topology.kubernetes.io/zone", "topology.kubernetes.io/region")
 
@@ -41,7 +41,12 @@ class _PodVolumeClaims:
 class VolumeBinding(Plugin):
     NAME = "VolumeBinding"
     EXTENSION_POINTS = ("PreFilter", "Filter", "Reserve", "PreBind")
-    EVENTS = ["Pod/Delete", "Node/Add", "Node/Update"]
+    # EventsToRegister parity: PVC/PV/StorageClass changes can make a pod
+    # rejected for volume reasons schedulable again.
+    EVENTS = ["Pod/Delete", "Node/Add", "Node/Update",
+              "PersistentVolumeClaim/Add", "PersistentVolumeClaim/Update",
+              "PersistentVolume/Add", "PersistentVolume/Update",
+              "StorageClass/Add"]
 
     def __init__(self, args=None):
         super().__init__(args)
@@ -154,7 +159,7 @@ class VolumeBinding(Plugin):
                 return Status.unschedulable(
                     "node(s) had volume node affinity conflict",
                     resolvable=False)
-        for pvc in claims.unbound_immediate:
+        if claims.unbound_immediate:
             # Immediate-mode claims are the PV controller's job; an unbound
             # one means binding hasn't happened yet (volume_binding.go
             # ErrReasonBindConflict path).
@@ -172,10 +177,10 @@ class VolumeBinding(Plugin):
 
     def reserve(self, state: CycleState, pod: PodInfo,
                 node_name: str) -> Status:
-        claims: _PodVolumeClaims | None = state.read(_STATE_KEY)
-        if claims is None or not claims.unbound_wffc:
-            return Status.success()
-        state.write(_STATE_KEY + "/selected", node_name)
+        # AssumePodVolumes equivalent: nothing to stage host-side (the
+        # binding plan is just the node choice, which pre_bind/unreserve
+        # receive directly); Reserve registration exists so Unreserve runs
+        # the annotation rollback on a failed cycle.
         return Status.success()
 
     def unreserve(self, state: CycleState, pod: PodInfo,
@@ -251,8 +256,9 @@ class VolumeZone(Plugin):
     topology labels (volumezone/volume_zone.go)."""
 
     NAME = "VolumeZone"
-    EXTENSION_POINTS = ("Filter",)
-    EVENTS = ["Node/Add", "Node/Update"]
+    EXTENSION_POINTS = ("PreFilter", "Filter")
+    EVENTS = ["Node/Add", "Node/Update",
+              "PersistentVolumeClaim/Update", "PersistentVolume/Add"]
 
     def __init__(self, args=None):
         super().__init__(args)
@@ -317,8 +323,12 @@ class NodeVolumeLimits(Plugin):
         return self.max_volumes
 
     def filter(self, state: CycleState, pod: PodInfo, node: NodeInfo) -> Status:
-        in_use = sum(len(pi.pvc_names) for pi in node.pods)
-        if in_use + len(pod.pvc_names) > self._node_limit(node):
+        # Unique volumes, not PVC references: pods sharing a claim share one
+        # attachment (csi.go dedupes by volume unique-name).
+        in_use = {f"{pi.namespace}/{name}"
+                  for pi in node.pods for name in pi.pvc_names}
+        new = {f"{pod.namespace}/{name}" for name in pod.pvc_names} - in_use
+        if len(in_use) + len(new) > self._node_limit(node):
             return Status.unschedulable(
                 "node(s) exceed max volume count", resolvable=True)
         return Status.success()

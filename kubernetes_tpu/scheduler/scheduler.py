@@ -198,6 +198,38 @@ class Scheduler:
         nodes.add_event_handler(ResourceEventHandler(
             on_add=on_node_add, on_update=on_node_update, on_delete=on_node_delete))
 
+        # Secondary resources plugins declared EVENTS for (addAllEventHandlers
+        # registers an informer per EventResource): PVC/PV/StorageClass churn
+        # must re-activate pods parked for volume reasons. Only the declared
+        # (kind, action) labels get handlers, and move_all runs even with
+        # nothing parked so in-flight cycles are marked for backoff
+        # (_moved_while_in_flight) when the event races their failure.
+        labels = {label
+                  for fwk in self.profiles.values()
+                  for p in fwk.plugins
+                  for label in getattr(p, "EVENTS", [])}
+        resource_of = {
+            "PersistentVolumeClaim": "persistentvolumeclaims",
+            "PersistentVolume": "persistentvolumes",
+            "StorageClass": "storageclasses",
+        }
+        for kind, resource in resource_of.items():
+
+            def poke(action, kind=kind):
+                def handler(*_args):
+                    asyncio.ensure_future(
+                        self.queue.move_all(ClusterEvent(kind, action)))
+                return handler
+
+            handlers = {}
+            if f"{kind}/Add" in labels:
+                handlers["on_add"] = poke("Add")
+            if f"{kind}/Update" in labels:
+                handlers["on_update"] = poke("Update")
+            if handlers:
+                factory.informer(resource).add_event_handler(
+                    ResourceEventHandler(**handlers))
+
     def _responsible(self, pi: PodInfo) -> bool:
         return pi.scheduler_name in self.profiles
 

@@ -160,7 +160,8 @@ class MVCCStore:
         self._subresources: dict[tuple[str, str], Callable[..., Awaitable[dict]]] = {}
         # Admission/validation hooks per resource, run before create/update.
         self._validators: dict[str, list[Callable[[dict], None]]] = {}
-        self._mutators: dict[str, list[Callable[[dict], None]]] = {}
+        self._mutators: dict[
+            str, list[tuple[Callable[[dict], None], frozenset[str]]]] = {}
 
     # -- helpers -----------------------------------------------------------
 
@@ -228,12 +229,16 @@ class MVCCStore:
     def register_validator(self, resource: str, fn: Callable[[dict], None]) -> None:
         self._validators.setdefault(resource, []).append(fn)
 
-    def register_mutator(self, resource: str, fn: Callable[[dict], None]) -> None:
-        self._mutators.setdefault(resource, []).append(fn)
+    def register_mutator(self, resource: str, fn: Callable[[dict], None], *,
+                         on: tuple[str, ...] = ("create", "update")) -> None:
+        """`on` restricts which operations run the mutator — admission
+        plugins like DefaultStorageClass apply at create only."""
+        self._mutators.setdefault(resource, []).append((fn, frozenset(on)))
 
-    def _admit(self, resource: str, obj: dict) -> None:
-        for fn in self._mutators.get(resource, []):
-            fn(obj)
+    def _admit(self, resource: str, obj: dict, op: str = "create") -> None:
+        for fn, ops in self._mutators.get(resource, []):
+            if op in ops:
+                fn(obj)
         for fn in self._validators.get(resource, []):
             fn(obj)
 
@@ -296,7 +301,7 @@ class MVCCStore:
                 f"{resource} {key!r}: resourceVersion mismatch "
                 f"(have {current['metadata']['resourceVersion']}, got {want_rv})"
             )
-        self._admit(resource, obj)
+        self._admit(resource, obj, "update")
         # Immutable metadata carries over (uid, creationTimestamp).
         obj["metadata"]["uid"] = current["metadata"].get("uid", obj["metadata"].get("uid"))
         obj["metadata"].setdefault(

@@ -94,7 +94,39 @@ def default_pod(pod: dict) -> None:
             })
 
 
+DEFAULT_CLASS_ANN = "storageclass.kubernetes.io/is-default-class"
+
+
 def install_core_validation(store) -> None:
     store.register_mutator("pods", default_pod)
     store.register_validator("pods", validate_pod)
     store.register_validator("nodes", validate_node)
+
+    def default_storage_class(pvc: dict) -> None:
+        """DefaultStorageClass admission (plugin/pkg/admission/storage/
+        storageclass/setdefault): PVCs with a nil class get the cluster's
+        default StorageClass at create time. An explicit "" means "no
+        class" and disables defaulting; ties between multiple defaults go
+        to the newest by creationTimestamp."""
+        spec = pvc.setdefault("spec", {})
+        if spec.get("storageClassName") is not None:
+            return
+        defaults = [
+            sc for sc in store._table("storageclasses").values()
+            if (sc.get("metadata", {}).get("annotations") or {})
+            .get(DEFAULT_CLASS_ANN) == "true"
+        ]
+        if not defaults:
+            return
+        # Newest creationTimestamp wins; ties break on smallest name
+        # (the reference sorts newest-first, then Name ascending).
+        latest = max(sc["metadata"].get("creationTimestamp") or ""
+                     for sc in defaults)
+        newest = min(
+            (sc for sc in defaults
+             if (sc["metadata"].get("creationTimestamp") or "") == latest),
+            key=lambda sc: sc["metadata"]["name"])
+        spec["storageClassName"] = newest["metadata"]["name"]
+
+    store.register_mutator("persistentvolumeclaims", default_storage_class,
+                           on=("create",))

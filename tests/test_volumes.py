@@ -204,24 +204,49 @@ class TestVolumeBindingE2E:
             await asyncio.sleep(0.5)
             pod = await store.get("pods", "default/app")
             assert not pod["spec"].get("nodeName")
-            # A local PV on n2 appears; Node/Add-ish event requeues via
-            # the 60s flush or PV informers — poke with a node update.
+            # A local PV on n2 appears; the PersistentVolume/Add event
+            # registered via VolumeBinding.EVENTS requeues the pod — no
+            # manual poke, no 60s flush wait.
             pv = make_pv("local-1", "10Gi", storage_class="local",
                          node_affinity={"nodeSelectorTerms": [{
                              "matchFields": [{"key": "metadata.name",
                                               "operator": "In",
                                               "values": ["n2"]}]}]})
             await store.create("persistentvolumes", pv)
-            await sched.queue.move_all(
-                __import__("kubernetes_tpu.scheduler.queue",
-                           fromlist=["ClusterEvent"]).ClusterEvent(
-                               "Node", "Update"))
 
             async def pod_bound():
                 p = await store.get("pods", "default/app")
                 return p["spec"].get("nodeName")
             node = await wait_for(pod_bound, timeout=15.0)
             assert node == "n2"
+            await teardown()
+        run(body())
+
+    def test_immediate_pvc_bind_requeues_parked_pod(self):
+        """A pod rejected for an unbound immediate PVC re-activates on the
+        PersistentVolumeClaim/Update event when the binder binds the claim
+        — without waiting for the 60s leftover flush (EventsToRegister
+        parity for the volume family)."""
+        async def body():
+            store, sched, teardown = await volume_stack()
+            # Immediate-mode class, but no PV and dynamic provisioning off:
+            # the claim stays Pending, the pod parks.
+            await store.create("storageclasses", make_storage_class(
+                "slow", provisioner="kubernetes.io/no-provisioner"))
+            await store.create("persistentvolumeclaims", make_pvc(
+                "data", storage_class="slow"))
+            await store.create("pods", pod_with_pvc("app", "data"))
+            await asyncio.sleep(0.4)
+            assert sched.queue.stats()["unschedulable"] == 1
+            # A matching PV appears; the binder binds the claim; the
+            # PVC/PV informer events must requeue the pod promptly.
+            await store.create("persistentvolumes", make_pv(
+                "pv-slow", "10Gi", storage_class="slow"))
+
+            async def pod_bound():
+                p = await store.get("pods", "default/app")
+                return p["spec"].get("nodeName")
+            assert await wait_for(pod_bound, timeout=10.0)
             await teardown()
         run(body())
 

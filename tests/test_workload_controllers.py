@@ -5,13 +5,14 @@ mirroring test_full_chain_deployment_to_running_pods."""
 import asyncio
 
 from kubernetes_tpu.api.meta import namespaced_name
-from kubernetes_tpu.api.types import make_node
+from kubernetes_tpu.api.types import make_node, make_storage_class
 from kubernetes_tpu.client import InformerFactory
 from kubernetes_tpu.controllers import (
     ControllerManager,
     DaemonSetController,
     JobController,
     KwokController,
+    PVBinderController,
     StatefulSetController,
     make_daemonset,
     make_job,
@@ -38,9 +39,17 @@ async def full_stack(controllers, node_count=3):
     """store + kwok nodes + controllers + scheduler, all wired."""
     store = new_cluster_store()
     install_core_validation(store)
+    # Default StorageClass so StatefulSet volumeClaimTemplates provision
+    # (the DefaultStorageClass admission mutator picks it up).
+    await store.create("storageclasses", make_storage_class(
+        "standard", binding_mode="WaitForFirstConsumer", is_default=True))
     kwok = KwokController(store, node_count=node_count, lease_period=0.5)
     await kwok.register_nodes()
-    mgr = ControllerManager(store, [c(store) for c in controllers] + [kwok])
+    # PV binder always runs (it is part of kube-controller-manager in the
+    # reference); StatefulSet volumeClaimTemplates need it to provision.
+    mgr = ControllerManager(
+        store,
+        [c(store) for c in controllers] + [PVBinderController(store), kwok])
     await mgr.start()
     sched = Scheduler(store, seed=7)
     factory = InformerFactory(store)
