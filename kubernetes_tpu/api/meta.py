@@ -13,13 +13,21 @@ N wire versions; we have one).
 
 from __future__ import annotations
 
+import itertools
+import os
 import time
-import uuid
 from typing import Any, Mapping
+
+# uuid4() costs an os.urandom syscall per call — at scheduler_perf scale
+# (every pod + every Event records a uid) it was >50% of the measured-phase
+# wall on one core. One random 64-bit boot epoch + a process-local counter
+# keeps uids unique across restarts at ~30ns each.
+_UID_EPOCH = os.urandom(8).hex()
+_UID_SEQ = itertools.count(1)
 
 
 def new_uid() -> str:
-    return str(uuid.uuid4())
+    return f"{_UID_EPOCH}-{next(_UID_SEQ):x}"
 
 
 def new_object(

@@ -10,6 +10,8 @@ format is ~100 lines and keeps the zero-install constraint.
 
 from __future__ import annotations
 
+import bisect
+import itertools
 import math
 import threading
 from collections import defaultdict
@@ -67,21 +69,31 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels: str) -> None:
+        # Single-bucket increment (bisect); cumulative "le" semantics are
+        # materialized at read time. The per-bucket loop here was measurable
+        # at scheduler_perf scale (2-3 observes per pod x 16 buckets).
         key = tuple(labels.get(n, "") for n in self.label_names)
+        i = bisect.bisect_left(self.buckets, value)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * len(self.buckets)
+            if i < len(counts):
+                counts[i] += 1
             self._sums[key] += value
             self._totals[key] += 1
 
+    def _cumulative(self, key: tuple) -> list[int]:
+        counts = self._counts.get(key)
+        if counts is None:
+            return [0] * len(self.buckets)
+        return list(itertools.accumulate(counts))
+
     def snapshot(self, **labels: str) -> tuple[list[int], int]:
-        """(bucket counts, total) at this instant — pair with
+        """(cumulative bucket counts, total) at this instant — pair with
         percentile_since for windowed percentiles (bench measured phase)."""
         key = tuple(labels.get(n, "") for n in self.label_names)
-        return list(self._counts.get(key) or [0] * len(self.buckets)), \
-            self._totals.get(key, 0)
+        return self._cumulative(key), self._totals.get(key, 0)
 
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket counts (for reports/bench)."""
@@ -96,11 +108,11 @@ class Histogram:
         ≥ value), so the first bucket whose delta reaches the rank is the
         answer directly."""
         key = tuple(labels.get(n, "") for n in self.label_names)
-        counts = self._counts.get(key)
         base_counts, base_total = base
         total = self._totals.get(key, 0) - base_total
-        if not counts or total <= 0:
+        if key not in self._counts or total <= 0:
             return math.nan
+        counts = self._cumulative(key)
         rank = q * total
         for i, (c, b) in enumerate(zip(counts, base_counts)):
             if c - b >= rank:
@@ -119,7 +131,7 @@ class Histogram:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         for key in sorted(self._totals):
             base = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
-            counts = self._counts[key]
+            counts = self._cumulative(key)
             for b, c in zip(self.buckets, counts):
                 sep = "," if base else ""
                 lines.append(f'{self.name}_bucket{{{base}{sep}le="{b}"}} {c}')

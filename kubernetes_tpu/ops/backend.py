@@ -218,7 +218,8 @@ class TPUBackend:
             self._sh_rep = NamedSharding(self.mesh, PartitionSpec())
         self._ct: ClusterTensors | None = None
         # (plugin, sig) -> np row; valid while _row_fp matches.
-        self._row_cache: dict[tuple[str, str], np.ndarray] = {}
+        self._row_cache: dict[
+            tuple[str, str], tuple[np.ndarray, bool]] = {}
         self._row_fp: tuple | None = None
         # Device-resident constants for the common "no host rows" case:
         # uploading a (P,N) bool+f32 pair every batch (~6.5 MB at 5k nodes)
@@ -284,10 +285,12 @@ class TPUBackend:
     # -- host rows -----------------------------------------------------------
 
     def _static_filter_row(self, plugin, pi: PodInfo, snapshot: Snapshot,
-                           ct: ClusterTensors) -> np.ndarray:
+                           ct: ClusterTensors) -> tuple[np.ndarray, bool]:
+        """Returns (row, all_true). all() is cached with the row: re-scanning
+        a 5k-wide row per pod per plugin was a top-3 host cost at perf scale."""
         key = (plugin.NAME, _signature(plugin.NAME, pi))
-        row = self._row_cache.get(key)
-        if row is None:
+        hit = self._row_cache.get(key)
+        if hit is None:
             state = CycleState()
             st = plugin.pre_filter(state, pi, snapshot)
             if st.is_skip() or st.is_success():
@@ -297,20 +300,21 @@ class TPUBackend:
                     dtype=np.bool_, count=ct.n_real)
             else:
                 row = np.zeros((ct.n_real,), dtype=np.bool_)
-            self._row_cache[key] = row
-        return row
+            hit = self._row_cache[key] = (row, bool(row.all()))
+        return hit
 
     def _static_score_row(self, plugin, pi: PodInfo, snapshot: Snapshot,
-                          ct: ClusterTensors) -> np.ndarray:
+                          ct: ClusterTensors) -> tuple[np.ndarray, bool]:
+        """Returns (row, any_nonzero); see _static_filter_row on caching."""
         key = (plugin.NAME + "/score", _signature(plugin.NAME, pi))
-        row = self._row_cache.get(key)
-        if row is None:
+        hit = self._row_cache.get(key)
+        if hit is None:
             state = CycleState()
             row = np.fromiter(
                 (plugin.score(state, pi, ni) for ni in snapshot.nodes),
                 dtype=np.float32, count=ct.n_real)
-            self._row_cache[key] = row
-        return row
+            hit = self._row_cache[key] = (row, bool(row.any()))
+        return hit
 
     def _dynamic_filter_row(self, plugin, pi: PodInfo, snapshot: Snapshot,
                             ct: ClusterTensors,
@@ -500,8 +504,10 @@ class TPUBackend:
                 for i, pi in enumerate(pods):
                     if i in unknown_res:
                         continue
-                    apply_row(plugin.NAME, i,
-                              self._static_filter_row(plugin, pi, snapshot, ct))
+                    row, all_true = self._static_filter_row(
+                        plugin, pi, snapshot, ct)
+                    if not all_true:
+                        apply_row(plugin.NAME, i, row)
             else:
                 gate = _FILTER_ACTIVE.get(plugin.NAME)
                 for i, pi in enumerate(pods):
@@ -581,8 +587,9 @@ class TPUBackend:
                             (pi.affinity.get("nodeAffinity") or {})
                             .get("preferredDuringSchedulingIgnoredDuringExecution")):
                         continue
-                    row = self._static_score_row(plugin, pi, snapshot, ct)
-                    if not row.any():
+                    row, any_nonzero = self._static_score_row(
+                        plugin, pi, snapshot, ct)
+                    if not any_nonzero:
                         continue
                     raw = {ct.node_names[j]: float(row[j])
                            for j in feasible_idx(i)}

@@ -79,8 +79,10 @@ class TestCRUD:
             rs = RemoteStore(srv.url)
             await rs.create("nodes", make_node("n1"))
             await rs.create("pods", make_pod("a", "default"))
-            bound = await rs.subresource(
+            st = await rs.subresource(
                 "pods", "default/a", "binding", {"target": {"name": "n1"}})
+            assert st["status"] == "Success"
+            bound = await rs.get("pods", "default/a")
             assert bound["spec"]["nodeName"] == "n1"
             with pytest.raises(Conflict):
                 await rs.subresource(

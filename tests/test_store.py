@@ -203,7 +203,10 @@ class TestBinding:
                 "target": {"kind": "Node", "name": "node-1"},
                 "metadata": {"uid": pod["metadata"]["uid"]},
             }
-            bound = await s.subresource("pods", "default/a", "binding", binding)
+            st = await s.subresource("pods", "default/a", "binding", binding)
+            # BindingREST.Create returns metav1.Status, not the pod.
+            assert st["kind"] == "Status" and st["status"] == "Success"
+            bound = await s.get("pods", "default/a")
             assert bound["spec"]["nodeName"] == "node-1"
             conds = {c["type"]: c["status"] for c in bound["status"]["conditions"]}
             assert conds["PodScheduled"] == "True"
