@@ -172,6 +172,43 @@ def make_storage_class(name: str, *,
     return sc
 
 
+def make_node_resource_topology(
+        node_name: str,
+        zones: list[dict],
+        policies: list[str] | None = None) -> dict:
+    """topology.node.k8s.io/v1alpha2 NodeResourceTopology (the scheduler-
+    plugins NUMA CRD; see plugins/noderesourcetopology.py). `zones` entries:
+    {"name": ..., "resources": [{"name": ..., "capacity": ...}, ...]}."""
+    nrt = new_object("NodeResourceTopology", node_name, None,
+                     api_version="topology.node.k8s.io/v1alpha2")
+    nrt["topologyPolicies"] = list(
+        policies or ["SingleNUMANodeContainerLevel"])
+    nrt["zones"] = zones
+    return nrt
+
+
+def split_node_topology(node_name: str, allocatable: Mapping[str, str],
+                        num_zones: int = 2,
+                        zoned: tuple[str, ...] = ("cpu",),
+                        devices: Mapping[str, int] | None = None) -> dict:
+    """Convenience: split a node's allocatable evenly into `num_zones` NUMA
+    zones (cpu + extended device resources), the shape a device-manager
+    node agent would report."""
+    from kubernetes_tpu.api.resource import format_quantity, parse_quantity
+    zones = []
+    for z in range(num_zones):
+        res = []
+        for r in zoned:
+            if r in allocatable:
+                res.append({"name": r, "capacity": format_quantity(
+                    parse_quantity(allocatable[r]) // num_zones)})
+        for r, per_zone in (devices or {}).items():
+            res.append({"name": r, "capacity": str(per_zone)})
+        zones.append({"name": f"{node_name}-numa-{z}", "type": "Node",
+                      "resources": res})
+    return make_node_resource_topology(node_name, zones)
+
+
 def make_binding(pod: Mapping, node_name: str) -> dict:
     """core/v1 Binding: target node for a pod; POSTed to the pod's /binding
     subresource (pkg/registry/core/pod/storage `BindingREST.Create`)."""

@@ -81,6 +81,8 @@ _FILTER_ACTIVE = {
     "VolumeBinding": lambda plugin, pi, snap: bool(pi.pvc_names),
     "VolumeZone": lambda plugin, pi, snap: bool(pi.pvc_names),
     "NodeVolumeLimits": lambda plugin, pi, snap: bool(pi.pvc_names),
+    "NodeResourceTopologyMatch":
+        lambda plugin, pi, snap: plugin.active_for(pi),
 }
 _SCORE_ACTIVE = {
     "InterPodAffinity": lambda plugin, pi, snap: bool(
@@ -88,6 +90,8 @@ _SCORE_ACTIVE = {
         or snap.have_pods_with_affinity),
     "PodTopologySpread": lambda plugin, pi, snap: bool(
         plugin._constraints_for(pi, "ScheduleAnyway")),
+    "NodeResourceTopologyMatch":
+        lambda plugin, pi, snap: plugin.active_for(pi),
 }
 
 
@@ -237,6 +241,9 @@ class TPUBackend:
         # chunk's solve so successive chunks dispatch with no host
         # round-trip.
         self._dev_used = None
+        # Vectorized NodeResourceTopologyMatch zone state, cached per
+        # (snapshot generation, snapshot identity) — see _nrt_state.
+        self._nrt_cache: tuple | None = None
 
     # -- device placement ----------------------------------------------------
 
@@ -264,6 +271,114 @@ class TPUBackend:
             from kubernetes_tpu.ops.affinity import AffinityCompiler
             self._affinity = AffinityCompiler(snapshot, ct.n_pad)
         return self._affinity
+
+    # -- NodeResourceTopologyMatch vectorization (BASELINE config #4) -----
+
+    def _nrt_state(self, plugin, snapshot: Snapshot,
+                   ct: ClusterTensors) -> dict | None:
+        """Batch-start zone-free tensors for NodeResourceTopologyMatch:
+        free/cap (N, Zmax, T), zone_valid (N, Zmax), tracked (N, T) over
+        the union T of zone-listed resources. Running the host plugin's
+        pack_zones per (pod × node) is O(P·N·residents); this packs each
+        node ONCE per assign() and answers rows with numpy broadcasting.
+        Within-batch drift is caught by the stateful full re-check in
+        _verify (same delta pattern as PodTopologySpread)."""
+        # nrt_seq invalidates on NRT object churn (which does not move the
+        # snapshot generation); id(plugin) separates per-profile instances.
+        key = (ct.generation, id(snapshot), id(plugin), plugin.nrt_seq)
+        if self._nrt_cache is not None and self._nrt_cache[0] == key:
+            return self._nrt_cache[1]
+        from kubernetes_tpu.scheduler.plugins.noderesourcetopology import (
+            SINGLE_NUMA_POLICIES, _zone_caps, pack_zones)
+        T = sorted(plugin._zone_resources)
+        t_index = {r: j for j, r in enumerate(T)}
+        N = ct.n_real
+        per_node: list[tuple | None] = []
+        zmax = 1
+        for ni in snapshot.nodes:
+            nrt = plugin._nrt(ni.name)
+            if nrt is None or not (
+                    set(nrt.get("topologyPolicies") or [])
+                    & SINGLE_NUMA_POLICIES):
+                per_node.append(None)
+                continue
+            caps = [c for _, c in _zone_caps(nrt)]
+            per_node.append((pack_zones(nrt, ni), caps))
+            zmax = max(zmax, len(caps))
+        free = np.zeros((N, zmax, len(T)), dtype=np.int64)
+        cap = np.zeros_like(free)
+        zone_valid = np.zeros((N, zmax), dtype=np.bool_)
+        tracked = np.zeros((N, len(T)), dtype=np.bool_)
+        for n, entry in enumerate(per_node):
+            if entry is None:
+                continue
+            zfree, zcaps = entry
+            for z, (zf, zc) in enumerate(zip(zfree, zcaps)):
+                zone_valid[n, z] = True
+                for r, v in zc.items():
+                    j = t_index[r]
+                    cap[n, z, j] = v
+                    tracked[n, j] = True
+                for r, v in zf.items():
+                    free[n, z, t_index[r]] = v
+        state = {"T": T, "t_index": t_index, "free": free, "cap": cap,
+                 "zone_valid": zone_valid, "tracked": tracked,
+                 "strategy": plugin.strategy}
+        self._nrt_cache = (key, state)
+        return state
+
+    @staticmethod
+    def _nrt_req_vec(st: dict, pi: PodInfo) -> np.ndarray:
+        q = np.zeros(len(st["T"]), dtype=np.int64)
+        for r, v in pi.requests.items():
+            j = st["t_index"].get(r)
+            if j is not None and v > 0:
+                q[j] = v
+        return q
+
+    def _nrt_pod_eval(self, st: dict, pi: PodInfo, memo: dict, i: int):
+        """Per-pod (q, constrained, zone_fit), memoized per chunk — the
+        Filter and Score phases share the (N, Zmax, T) reduction."""
+        hit = memo.get(i)
+        if hit is None:
+            q = self._nrt_req_vec(st, pi)
+            qpos = (q > 0)[None, None, :]
+            constrained = (st["tracked"] & (q > 0)[None, :]).any(-1)
+            viol = st["tracked"][:, None, :] & qpos \
+                & (st["free"] < q[None, None, :])
+            zone_fit = st["zone_valid"] & ~viol.any(-1)
+            hit = memo[i] = (q, constrained, zone_fit)
+        return hit
+
+    def _nrt_filter_row(self, st: dict, pi: PodInfo, memo: dict,
+                        i: int) -> np.ndarray:
+        """(n_real,) bool: host plugin's filter() vectorized."""
+        _, constrained, zone_fit = self._nrt_pod_eval(st, pi, memo, i)
+        return ~constrained | zone_fit.any(-1)
+
+    def _nrt_score_row(self, st: dict, pi: PodInfo, memo: dict,
+                       i: int) -> np.ndarray:
+        """(n_real,) float: host plugin's score() vectorized (best zone by
+        the configured strategy; 0 for unconstrained/unfitting nodes)."""
+        q, constrained, zone_fit = self._nrt_pod_eval(st, pi, memo, i)
+        qpos = (q > 0)[None, None, :]
+        m = (st["cap"] > 0) & qpos
+        cnt = m.sum(-1)
+        safe_cap = np.maximum(st["cap"], 1)
+        fr = np.where(m, (st["free"] - q[None, None, :]) / safe_cap, 0.0)
+        denom = np.maximum(cnt, 1)
+        mean = fr.sum(-1) / denom
+        if st["strategy"] == "MostAllocated":
+            s = 100.0 * (1.0 - mean)
+        elif st["strategy"] == "BalancedAllocation":
+            var = (np.where(m, fr * fr, 0.0).sum(-1) / denom) - mean * mean
+            s = 100.0 * (1.0 - np.sqrt(np.maximum(var, 0.0)))
+        else:  # LeastAllocated
+            s = 100.0 * mean
+        s = np.where(zone_fit & (cnt > 0), s, -np.inf)
+        best = s.max(-1)
+        return np.where(constrained & np.isfinite(best),
+                        np.maximum(best, 0.0), 0.0)
 
     def _ipa_score_relevant(self, pi: PodInfo, snapshot: Snapshot) -> bool:
         """InterPodAffinity Score is nonzero only if the pod has preferred
@@ -385,6 +500,7 @@ class TPUBackend:
         ctx.delta = []
         ctx.delta_has_terms = False
         ctx.sel_cache = {}
+        ctx.wsnap = None
         ctx.params = self._fwk_params(fwk, ct)
         # Fresh used-state upload (ONE packed array, ~80 KB) per call;
         # chunks chain on device from here.
@@ -477,6 +593,7 @@ class TPUBackend:
         # Host-side rows: static predicate plugins (signature-cached) and
         # stateful irregular plugins (per pod, Skip-gated).
         dyn_states: dict[int, CycleState] = {}
+        nrt_memo: dict[int, tuple] = {}
         host_filter_fail: dict[str, np.ndarray] = {}  # plugin -> (P,N) ok-mask
         #: pods whose NON-affinity stateful filter gate fired (full host
         #: re-verification). Affinity-handled pods are covered by the cheap
@@ -525,6 +642,15 @@ class TPUBackend:
                             if not row.all():
                                 apply_row(plugin.NAME, i, row)
                             continue
+                    if plugin.NAME == "NodeResourceTopologyMatch":
+                        # Vectorized zone-alignment rows from batch-start
+                        # zone state; in-batch drift → stateful re-check.
+                        st_nrt = self._nrt_state(plugin, snapshot, ct)
+                        row = self._nrt_filter_row(st_nrt, pi, nrt_memo, i)
+                        if not row.all():
+                            apply_row(plugin.NAME, i, row)
+                        stateful_pods.add(i)
+                        continue
                     if plugin.NAME == "PodTopologySpread":
                         constraints = plugin._constraints_for(
                             pi, "DoNotSchedule")
@@ -596,6 +722,13 @@ class TPUBackend:
                 else:
                     gate = _SCORE_ACTIVE.get(name)
                     if gate is not None and not gate(plugin, pi, snapshot):
+                        continue
+                    if name == "NodeResourceTopologyMatch":
+                        st_nrt = self._nrt_state(plugin, snapshot, ct)
+                        srow = self._nrt_score_row(st_nrt, pi, nrt_memo, i)
+                        if srow.any():
+                            host_scores[i, : ct.n_real] += w * srow
+                            scores_modified = True
                         continue
                     if name == "PodTopologySpread":
                         # Tensorized raw counts + vectorized NormalizeScore
@@ -778,6 +911,19 @@ class TPUBackend:
             if ni is None:
                 ni = snapshot.get(name).clone()
                 working[name] = ni
+                # Patch the shared working snapshot in place (clones mutate
+                # in place afterwards, so list entries stay current).
+                w = ctx.wsnap
+                if w is not None:
+                    old = w._by_name.get(name)
+                    w.nodes[idx] = ni
+                    w._by_name[name] = ni
+                    for lst in (w.have_pods_with_affinity,
+                                w.have_pods_with_required_anti_affinity):
+                        for k, entry in enumerate(lst):
+                            if entry is old:
+                                lst[k] = ni
+                                break
             return ni
 
         full_check_batch = bool(stateful_pods)
@@ -814,9 +960,15 @@ class TPUBackend:
                 continue
             if full_check_batch:
                 # Non-IPA stateful plugins in play → full host re-check.
-                wsnap = Snapshot(
-                    [working.get(n.name, n) for n in snapshot.nodes],
-                    snapshot.generation)
+                # The working snapshot is built ONCE per assign() and kept
+                # current: working clones mutate in place, and node_for
+                # patches in new clones — rebuilding a Snapshot per pod was
+                # O(N) per pod (the spread/NRT families' top host cost).
+                wsnap = ctx.wsnap
+                if wsnap is None:
+                    wsnap = ctx.wsnap = Snapshot(
+                        [working.get(n.name, n) for n in snapshot.nodes],
+                        snapshot.generation)
                 state = CycleState()
                 st = fwk.run_pre_filter(state, pi, wsnap)
                 if st.is_success():
@@ -835,6 +987,15 @@ class TPUBackend:
                     continue
             assignments[pi.key] = ni.name
             ni.add_pod(pi)
+            # Keep the shared working snapshot's affinity indexes current
+            # (Snapshot.__init__ derives them; add_pod bypasses that).
+            if ctx.wsnap is not None:
+                if pi.has_affinity_constraints and \
+                        ni not in ctx.wsnap.have_pods_with_affinity:
+                    ctx.wsnap.have_pods_with_affinity.append(ni)
+                if pi.has_required_anti_affinity and ni not in \
+                        ctx.wsnap.have_pods_with_required_anti_affinity:
+                    ctx.wsnap.have_pods_with_required_anti_affinity.append(ni)
             delta.append((pi, ni.labels))
             if pi.required_affinity_terms or pi.required_anti_affinity_terms:
                 delta_has_terms = True
@@ -919,7 +1080,8 @@ class _AssignCtx:
 
     __slots__ = ("snapshot", "fwk", "ct", "chunks", "params",
                  "assignments", "diagnostics",
-                 "working", "delta", "delta_has_terms", "sel_cache")
+                 "working", "delta", "delta_has_terms", "sel_cache",
+                 "wsnap")
 
 
 def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict):

@@ -38,7 +38,8 @@ import time
 from typing import Any, Mapping
 
 from kubernetes_tpu.api.meta import namespaced_name
-from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.api.types import (make_node, make_pod,
+                                      split_node_topology)
 from kubernetes_tpu.client import InformerFactory
 from kubernetes_tpu.metrics.registry import SchedulerMetrics
 from kubernetes_tpu.scheduler import Scheduler
@@ -100,18 +101,29 @@ class PerfRunner:
     store + scheduler, mirroring mustSetupCluster → runWorkload."""
 
     def __init__(self, backend=None, batch_size: int = 1,
-                 scheduler_kwargs: Mapping | None = None):
+                 scheduler_kwargs: Mapping | None = None,
+                 scheduler_config: Mapping | None = None):
         self.backend = backend
         self.batch_size = batch_size
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        #: Optional inline KubeSchedulerConfiguration (a workload family may
+        #: enable non-default plugins, e.g. NodeResourceTopologyMatch).
+        self.scheduler_config = scheduler_config
 
     async def run(self, template_ops: list, params: Mapping[str, Any],
                   timeout: float = 600.0) -> WorkloadResult:
         store = new_cluster_store()
         install_core_validation(store)
         metrics = SchedulerMetrics()
+        profiles = None
+        if self.scheduler_config is not None:
+            from kubernetes_tpu.config.scheduler import load_config
+            cfg = load_config(self.scheduler_config)
+            profiles = {p.scheduler_name: p.build_framework(
+                store=store, metrics=metrics) for p in cfg.profiles}
         sched = Scheduler(store, seed=42, backend=self.backend,
-                          metrics=metrics, **self.scheduler_kwargs)
+                          metrics=metrics, profiles=profiles,
+                          **self.scheduler_kwargs)
         factory = InformerFactory(store)
         await sched.setup_informers(factory)
 
@@ -144,9 +156,21 @@ class PerfRunner:
                     count = _resolve_count(op, params)
                     tmpl = {**DEFAULT_NODE_TEMPLATE,
                             **(op.get("nodeTemplate") or {})}
+                    # Optional NUMA topology (BASELINE config #4): create a
+                    # NodeResourceTopology per node, splitting allocatable
+                    # across zones the way a device-manager agent reports.
+                    topo = op.get("topologyTemplate")
                     for i in range(count):
+                        name = f"node-{node_count + i}"
                         await store.create("nodes", make_node(
-                            f"node-{node_count + i}", **copy.deepcopy(tmpl)))
+                            name, **copy.deepcopy(tmpl)))
+                        if topo:
+                            await store.create(
+                                "noderesourcetopologies",
+                                split_node_topology(
+                                    name, tmpl.get("allocatable") or {},
+                                    num_zones=int(topo.get("zones", 2)),
+                                    devices=topo.get("devices")))
                     node_count += count
 
                 elif opcode == "createPods":
@@ -281,7 +305,8 @@ def run_suite(config: list[dict], backend_factory=None, batch_size: int = 1,
             if filter_name and filter_name not in full:
                 continue
             backend = backend_factory() if backend_factory else None
-            runner = PerfRunner(backend=backend, batch_size=batch_size)
+            runner = PerfRunner(backend=backend, batch_size=batch_size,
+                                scheduler_config=case.get("schedulerConfig"))
             res = asyncio.run(runner.run(
                 case["workloadTemplate"], wl.get("params") or {}))
             out[full] = res.as_dict()

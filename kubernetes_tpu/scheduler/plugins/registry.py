@@ -24,6 +24,9 @@ from kubernetes_tpu.scheduler.plugins.noderesources import (
     NodeResourcesFit,
 )
 from kubernetes_tpu.scheduler.plugins.coscheduling import Coscheduling
+from kubernetes_tpu.scheduler.plugins.noderesourcetopology import (
+    NodeResourceTopologyMatch,
+)
 from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
 from kubernetes_tpu.scheduler.plugins.volumebinding import (
     NodeVolumeLimits,
@@ -35,6 +38,7 @@ from kubernetes_tpu.scheduler.plugins.volumebinding import (
 #: registered but not default-enabled (out-of-tree in the reference).
 IN_TREE: dict[str, Callable] = {
     "Coscheduling": Coscheduling,
+    "NodeResourceTopologyMatch": NodeResourceTopologyMatch,
     "PrioritySort": PrioritySort,
     "SchedulingGates": SchedulingGates,
     "NodeResourcesFit": NodeResourcesFit,

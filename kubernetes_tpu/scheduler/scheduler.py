@@ -212,6 +212,7 @@ class Scheduler:
             "PersistentVolumeClaim": "persistentvolumeclaims",
             "PersistentVolume": "persistentvolumes",
             "StorageClass": "storageclasses",
+            "NodeResourceTopology": "noderesourcetopologies",
         }
         for kind, resource in resource_of.items():
 

@@ -1,0 +1,252 @@
+"""BASELINE config #4: device-plugin extended resources + NUMA topology
+(NodeResourceTopologyMatch over NodeResourceTopology objects — SURVEY §2.5
+cm/devicemanager + cm/topologymanager, scheduler-plugins noderesourcetopology)."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    make_node,
+    make_node_resource_topology,
+    make_pod,
+    split_node_topology,
+)
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.config.scheduler import load_config
+from kubernetes_tpu.metrics.registry import SchedulerMetrics
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.plugins.noderesourcetopology import pack_zones
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+TPU = "google.com/tpu"
+
+NRT_CONFIG = {
+    "apiVersion": "kubescheduler.config.k8s.io/v1",
+    "kind": "KubeSchedulerConfiguration",
+    "profiles": [{
+        "schedulerName": "default-scheduler",
+        "plugins": {"multiPoint": {
+            "enabled": [{"name": "NodeResourceTopologyMatch", "weight": 2}]}},
+    }],
+}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.02):
+    for _ in range(int(timeout / interval)):
+        v = await predicate()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return await predicate()
+
+
+def tpu_pod(name, tpus, cpu="500m"):
+    return make_pod(name, requests={"cpu": cpu, TPU: str(tpus)})
+
+
+def two_zone_node(name, tpus_per_zone=4):
+    node = make_node(name, allocatable={
+        "cpu": "16", "memory": "64Gi", "pods": "110",
+        TPU: str(2 * tpus_per_zone)})
+    nrt = split_node_topology(
+        name, {"cpu": "16"}, num_zones=2, devices={TPU: tpus_per_zone})
+    return node, nrt
+
+
+async def topo_stack(nodes_nrts, backend=None, batch_size=1):
+    store = new_cluster_store()
+    install_core_validation(store)
+    for node, nrt in nodes_nrts:
+        await store.create("nodes", node)
+        if nrt is not None:
+            await store.create("noderesourcetopologies", nrt)
+    metrics = SchedulerMetrics()
+    cfg = load_config(NRT_CONFIG)
+    profiles = {p.scheduler_name: p.build_framework(store=store,
+                                                    metrics=metrics)
+                for p in cfg.profiles}
+    sched = Scheduler(store, seed=3, profiles=profiles, metrics=metrics,
+                      backend=backend)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    task = asyncio.ensure_future(sched.run(batch_size=batch_size))
+
+    async def teardown():
+        await sched.stop()
+        task.cancel()
+        factory.stop()
+        store.stop()
+    return store, sched, teardown
+
+
+class TestPackZones:
+    def test_first_fit_deterministic(self):
+        nrt = make_node_resource_topology("n", [
+            {"name": "z0", "resources": [{"name": TPU, "capacity": "4"}]},
+            {"name": "z1", "resources": [{"name": TPU, "capacity": "4"}]},
+        ])
+        node = NodeInfo(make_node("n", allocatable={TPU: "8", "cpu": "8"}))
+        for pname, tpus in [("b", 3), ("a", 2)]:
+            node.add_pod(PodInfo(tpu_pod(pname, tpus)))
+        free = pack_zones(nrt, node)
+        # Sorted by key: "a"(2) → z0 (free 2), "b"(3) → z1 (free 1).
+        assert [f[TPU] for f in free] == [2000, 1000]
+
+    def test_unzoned_resources_unconstrained(self):
+        nrt = make_node_resource_topology("n", [
+            {"name": "z0", "resources": [{"name": TPU, "capacity": "4"}]}])
+        node = NodeInfo(make_node("n", allocatable={TPU: "4", "cpu": "8"}))
+        node.add_pod(PodInfo(make_pod("cpu-only", requests={"cpu": "4"})))
+        free = pack_zones(nrt, node)
+        assert free[0][TPU] == 4000  # cpu-only pod charges no zone
+
+
+class TestSingleNumaFilter:
+    def test_node_level_fit_but_zone_misaligned_rejected(self):
+        """Two 3-TPU pods fragment both zones (1+1 free); a 2-TPU pod fits
+        node-level (2 free) but no single zone — NRT must reject while
+        plain NodeResourcesFit would admit."""
+        async def body():
+            node, nrt = two_zone_node("n1")
+            store, sched, teardown = await topo_stack([(node, nrt)])
+            await store.create("pods", tpu_pod("frag-a", 3))
+            await store.create("pods", tpu_pod("frag-b", 3))
+
+            async def both_bound():
+                a = await store.get("pods", "default/frag-a")
+                b = await store.get("pods", "default/frag-b")
+                return bool(a["spec"].get("nodeName")) and \
+                    bool(b["spec"].get("nodeName"))
+            assert await wait_for(both_bound)
+
+            await store.create("pods", tpu_pod("misfit", 2))
+            await asyncio.sleep(0.5)
+            p = await store.get("pods", "default/misfit")
+            assert not p["spec"].get("nodeName")
+            assert sched.queue.stats()["unschedulable"] == 1
+            evs = (await store.list("events")).items
+            assert any("single NUMA zone" in (e.get("message") or "")
+                       for e in evs)
+            await teardown()
+        run(body())
+
+    def test_score_prefers_alignable_node(self):
+        """Node B has a whole free zone; node A is fragmented. The 4-TPU
+        pod can only fit B; a 1-TPU pod prefers the emptier zone node
+        by LeastAllocated zone scoring."""
+        async def body():
+            a, nrt_a = two_zone_node("a")
+            b, nrt_b = two_zone_node("b")
+            store, sched, teardown = await topo_stack(
+                [(a, nrt_a), (b, nrt_b)])
+            # Fragment A: 3+3 → zones 1/1.
+            await store.create("pods", tpu_pod("fa", 3))
+            await store.create("pods", tpu_pod("fb", 3))
+
+            async def a_fragmented():
+                pods = (await store.list("pods")).items
+                return sum(1 for p in pods
+                           if p["spec"].get("nodeName") == "a") == 2 or \
+                    sum(1 for p in pods if p["spec"].get("nodeName")) == 2
+            assert await wait_for(a_fragmented)
+            # 4-TPU pod: only an intact zone fits — wherever it goes, that
+            # node had a whole zone free.
+            await store.create("pods", tpu_pod("big", 4))
+
+            async def big_bound():
+                p = await store.get("pods", "default/big")
+                return p["spec"].get("nodeName")
+            node = await wait_for(big_bound)
+            assert node  # aligned somewhere a full zone existed
+            await teardown()
+        run(body())
+
+
+class TestNrtChurnRequeue:
+    def test_zone_capacity_increase_requeues_parked_pod(self):
+        """A pod parked on 'cannot align' re-activates when the node's
+        NodeResourceTopology gains zone capacity (EventsToRegister parity:
+        NRT updates fire a ClusterEvent through the secondary-resource
+        wiring, no 60s flush)."""
+        async def body():
+            node = make_node("n1", allocatable={
+                "cpu": "16", "memory": "64Gi", "pods": "110", TPU: "8"})
+            nrt = split_node_topology(
+                "n1", {"cpu": "16"}, num_zones=2, devices={TPU: 2})
+            store, sched, teardown = await topo_stack([(node, nrt)])
+            await store.create("pods", tpu_pod("big", 4))
+            await asyncio.sleep(0.4)
+            p = await store.get("pods", "default/big")
+            assert not p["spec"].get("nodeName")
+            # Agent reports bigger zones (e.g. devices came online).
+            bigger = split_node_topology(
+                "n1", {"cpu": "16"}, num_zones=2, devices={TPU: 4})
+            cur = await store.get("noderesourcetopologies", "n1")
+            bigger["metadata"] = cur["metadata"]
+            await store.update("noderesourcetopologies", bigger)
+
+            async def bound():
+                q = await store.get("pods", "default/big")
+                return q["spec"].get("nodeName")
+            assert await wait_for(bound, timeout=10.0) == "n1"
+            await teardown()
+        run(body())
+
+
+class TestExtendedResourcesEndToEnd:
+    @pytest.mark.parametrize("use_backend", [False, True])
+    def test_capacity_respected_both_backends(self, use_backend):
+        """Extended-resource columns flow through tensorize→kernels: 2
+        nodes × 8 TPUs fit exactly eight 2-TPU pods; the ninth parks."""
+        async def body():
+            backend = None
+            batch = 1
+            if use_backend:
+                from kubernetes_tpu.ops import TPUBackend
+                backend = TPUBackend(max_batch=32)
+                batch = 16
+            nodes = [two_zone_node(f"n{i}") for i in range(2)]
+            store, sched, teardown = await topo_stack(
+                nodes, backend=backend, batch_size=batch)
+            for i in range(9):
+                await store.create("pods", tpu_pod(f"p{i}", 2))
+
+            async def eight_bound():
+                pods = (await store.list("pods")).items
+                return sum(1 for p in pods
+                           if p["spec"].get("nodeName")) == 8
+            assert await wait_for(eight_bound, timeout=30.0)
+            await asyncio.sleep(0.3)
+            pods = (await store.list("pods")).items
+            bound = [p for p in pods if p["spec"].get("nodeName")]
+            assert len(bound) == 8  # never 9: 2×8 TPUs / 2 each
+            per_node = {}
+            for p in bound:
+                per_node[p["spec"]["nodeName"]] = \
+                    per_node.get(p["spec"]["nodeName"], 0) + 1
+            assert all(v == 4 for v in per_node.values())
+            await teardown()
+        run(body())
+
+
+class TestDeviceTopologyPerfFamily:
+    def test_family_runs_and_schedules_all(self):
+        from kubernetes_tpu.perf.scheduler_perf import load_config as load_suite
+        from kubernetes_tpu.perf.scheduler_perf import run_suite
+        import pathlib
+        cfg = load_suite(str(pathlib.Path(__file__).parent.parent /
+                             "kubernetes_tpu" / "perf" / "config" /
+                             "performance-config.yaml"))
+        out = run_suite(cfg, filter_name="DeviceTopology/100Nodes")
+        res = out["DeviceTopology/100Nodes"]
+        assert res["unschedulable_total"] == 0
+        assert res["scheduled_total"] == 300
+        assert res["throughput_pods_per_sec"] > 0
