@@ -42,6 +42,10 @@ def main(argv=None) -> int:
                          "backend chunks + pipelines internally")
     ap.add_argument("--chunk", type=int, default=2048,
                     help="backend solve chunk (jit batch signature)")
+    ap.add_argument("--through-apiserver", action="store_true",
+                    help="cross the process boundary: workload writes, "
+                         "informers, and binding POSTs go over the HTTP "
+                         "apiserver (reference scheduler_perf topology)")
     ap.add_argument("--feature-gates", default="",
                     help='e.g. "TPUScorer=true" — the north-star seam: the '
                          "batched device backend hangs off this gate "
@@ -86,14 +90,16 @@ def main(argv=None) -> int:
     # threshold trades peak RSS for wall, like tuning GOGC on the reference.
     gc.set_threshold(100_000, 50, 50)
 
-    runner = PerfRunner(backend=backend, batch_size=batch)
+    runner = PerfRunner(backend=backend, batch_size=batch,
+                        through_apiserver=args.through_apiserver)
     res = asyncio.run(runner.run(template, params, timeout=1800.0))
 
     detail = res.as_dict()
     print(json.dumps({"detail": detail, "preset": args.preset,
                       "backend": args.backend}, ), file=sys.stderr)
     print(json.dumps({
-        "metric": f"pods_per_sec_{args.preset}_nodes_{args.backend}",
+        "metric": f"pods_per_sec_{args.preset}_nodes_{args.backend}"
+                  + ("_apiserver" if args.through_apiserver else ""),
         "value": detail["throughput_pods_per_sec"],
         "unit": "pods/s",
         "vs_baseline": round(

@@ -65,6 +65,37 @@ class AffinityCompiler:
                         self.resident_anti[key] = (vec, term, pi.namespace)
                         got = self.resident_anti[key]
                     got[0][n] += 1.0
+        # Resident pods' PREFERRED terms + required-affinity terms (score
+        # symmetry sources — scoring.go's second loop): term signature →
+        # (weight-summed carrier vector over nodes, term, owner_ns).
+        # Preferred anti-affinity carriers get negative weights.
+        self.resident_score: dict[
+            str, tuple[np.ndarray, dict, str, bool]] = {}
+        self.score_ns_unsupported = False
+
+        def _carrier(term: dict, ns: str, n: int, w: float,
+                     is_hard: bool = False) -> None:
+            if term.get("namespaceSelector"):
+                self.score_ns_unsupported = True
+                return
+            key = repr((term, ns, is_hard))
+            got = self.resident_score.get(key)
+            if got is None:
+                got = self.resident_score[key] = (
+                    np.zeros((n_pad,), dtype=np.float32), term, ns, is_hard)
+            got[0][n] += w
+
+        for n, ni in enumerate(snapshot.nodes):
+            for pi in ni.pods_with_affinity:
+                for t in pi.preferred_affinity_terms:
+                    _carrier(t.get("podAffinityTerm") or {}, pi.namespace,
+                             n, float(t.get("weight", 1)))
+                for t in pi.preferred_anti_affinity_terms:
+                    _carrier(t.get("podAffinityTerm") or {}, pi.namespace,
+                             n, -float(t.get("weight", 1)))
+                for t in pi.required_affinity_terms:
+                    # hardPodAffinityWeight multiplies at score_row time.
+                    _carrier(t, pi.namespace, n, 1.0, is_hard=True)
         #: per-pending-pod-signature symmetry-match cache
         self._sym_match_cache: dict[tuple, bool] = {}
         #: per-(term,ns) per-node matching-count cache
@@ -184,6 +215,73 @@ class AffinityCompiler:
                 for per_node, has_key, _ in presences:
                     row &= has_key & (per_node > 0)
         row[self.n_real:] = False
+        return row
+
+    def score_supported(self, pod: PodInfo) -> bool:
+        """namespaceSelector needs per-namespace label matching the interned
+        tables don't model — those pods take the host score path."""
+        if self.score_ns_unsupported:
+            return False
+        return not any(
+            (t.get("podAffinityTerm") or {}).get("namespaceSelector")
+            for t in (pod.preferred_affinity_terms
+                      + pod.preferred_anti_affinity_terms))
+
+    def _masked_presence(self, counts: np.ndarray, topology_key: str,
+                         feasible: np.ndarray
+                         ) -> tuple[np.ndarray, np.ndarray]:
+        """_domain_presence restricted to feasible nodes — the host
+        pre_score iterates only the FILTERED node list, so residents on
+        infeasible nodes contribute nothing (per-pod, so uncached)."""
+        dom_ids, num = self.topo.domains(topology_key)
+        has_key = dom_ids > 0
+        d = _seg_sum(np.where(has_key & feasible, counts, 0.0),
+                     dom_ids, num)
+        d[0] = 0.0
+        return d[dom_ids], has_key
+
+    def score_row(self, pod: PodInfo, hard_weight: float,
+                  feasible: np.ndarray) -> np.ndarray:
+        """(n_pad,) raw InterPodAffinity score — exactly pre_score's
+        domain-weight accumulation (scoring.go) over the pod's FEASIBLE
+        nodes, vectorized: the pod's preferred (anti-)terms weigh matching
+        residents by domain; residents' preferred terms + required terms
+        (× hardPodAffinityWeight) weigh back symmetrically."""
+        row = np.zeros((self.n_pad,), dtype=np.float32)
+        for term in pod.preferred_affinity_terms:
+            t = term.get("podAffinityTerm") or {}
+            counts = self.counts_for(t.get("labelSelector"),
+                                     _term_ns(t, pod.namespace))
+            per_node, has_key = self._masked_presence(
+                counts, t.get("topologyKey", ""), feasible)
+            row += float(term.get("weight", 1)) * np.where(
+                has_key, per_node, 0.0)
+        for term in pod.preferred_anti_affinity_terms:
+            t = term.get("podAffinityTerm") or {}
+            counts = self.counts_for(t.get("labelSelector"),
+                                     _term_ns(t, pod.namespace))
+            per_node, has_key = self._masked_presence(
+                counts, t.get("topologyKey", ""), feasible)
+            row -= float(term.get("weight", 1)) * np.where(
+                has_key, per_node, 0.0)
+        from kubernetes_tpu.api.labels import from_label_selector
+        pod_sig = (pod.namespace, tuple(sorted(pod.labels.items())))
+        for key, (carriers, term, owner_ns, is_hard) in \
+                self.resident_score.items():
+            mk = ("score", key, pod_sig)
+            hit = self._sym_match_cache.get(mk)
+            if hit is None:
+                nses = _term_ns(term, owner_ns)
+                hit = pod.namespace in nses and from_label_selector(
+                    term.get("labelSelector")).matches(pod.labels)
+                self._sym_match_cache[mk] = hit
+            if not hit:
+                continue
+            per_node, has_key = self._masked_presence(
+                carriers, term.get("topologyKey", ""), feasible)
+            w = hard_weight if is_hard else 1.0
+            row += w * np.where(has_key, per_node, 0.0)
+        row[self.n_real:] = 0.0
         return row
 
     def _self_matches(self, pod: PodInfo) -> bool:

@@ -110,12 +110,15 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
     raise KeyError(plugin_name)
 
 
-@partial(jax.jit, static_argnames=("strategy", "use_auction"))
+@partial(jax.jit,
+         static_argnames=("strategy", "use_auction", "use_spread"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                        taint_f_mat, taint_p_mat, static_mask, host_scores,
                        fit_col_w, bal_col_mask, shape_u, shape_s,
                        w_fit, w_bal, w_taint, taint_filter_on,
-                       strategy: str, use_auction: bool):
+                       dom_onehot, cid_onehot, dom_counts, max_skew,
+                       spread_active,
+                       strategy: str, use_auction: bool, use_spread: bool):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -155,6 +158,7 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
 
     free_q = alloc_q - used_q
     free_pods = alloc_pods - used_pods
+    dom_counts2 = dom_counts
     if use_auction:
         total = static_scores
         total = total + w_fit * kernels.fit_score(
@@ -162,6 +166,12 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
         total = total + w_bal * kernels.balanced_allocation_score(
             alloc_q, used_nz_q, req_nz_q, bal_col_mask)
         assign = solver.auction_assign(req_q, free_q, free_pods, mask, total)
+    elif use_spread:
+        assign, dom_counts2 = solver.greedy_assign_rescoring_spread(
+            req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+            static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+            w_fit, w_bal, strategy,
+            dom_onehot, cid_onehot, dom_counts, max_skew, spread_active)
     else:
         assign = solver.greedy_assign_rescoring(
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
@@ -178,7 +188,7 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     used_pack2 = used_pack + jnp.zeros(
         (n + 1, used_pack.shape[1]), used_pack.dtype
     ).at[tgt].add(jnp.where(hit[:, None], inc, 0))[:n]
-    return assign, used_pack2, fit0, taint_ok
+    return assign, used_pack2, fit0, taint_ok, dom_counts2
 
 
 class TPUBackend:
@@ -244,6 +254,9 @@ class TPUBackend:
         # Vectorized NodeResourceTopologyMatch zone state, cached per
         # (snapshot generation, snapshot identity) — see _nrt_state.
         self._nrt_cache: tuple | None = None
+        # Fixed-shape placeholder device arrays for the fused program's
+        # spread slots when use_spread=False (stable jit signature).
+        self._spread_dummy_cache: dict[tuple, tuple] = {}
 
     # -- device placement ----------------------------------------------------
 
@@ -431,6 +444,165 @@ class TPUBackend:
             hit = self._row_cache[key] = (row, bool(row.any()))
         return hit
 
+    def _spread_dummies(self, n_pad: int, p: int) -> tuple:
+        key = (n_pad, p)
+        d = self._spread_dummy_cache.get(key)
+        if d is None:
+            d = (self._put(np.zeros((n_pad, 1), np.float32), "nodes_mat"),
+                 self._put(np.zeros((1, 1), np.float32)),
+                 self._put(np.zeros((1,), np.float32)),
+                 self._put(np.zeros((1,), np.float32)),
+                 self._put(np.zeros((p,), np.bool_)))
+            self._spread_dummy_cache[key] = d
+        return d
+
+    def _process_spread_pods(self, spread_pods, pods, ctx, snapshot, ct,
+                             apply_row, stateful_pods, dyn_states,
+                             fwk) -> list[int]:
+        """Hard (DoNotSchedule) PodTopologySpread routing.
+
+        Homogeneous template — every spread pod in the batch shares ONE
+        constraint set, self-matches its selectors, all nodes are eligible,
+        and no other batch pod matches the selectors — goes to the DEVICE
+        scan (solver.greedy_assign_rescoring_spread): domain counts ride
+        the scan carry, so tight maxSkew stays sequential-exact without
+        the batch-then-verify requeue collapse. Anything else poisons the
+        template and falls back to host rows + stateful verify."""
+        from kubernetes_tpu.api.labels import from_label_selector
+        from kubernetes_tpu.ops.affinity import _seg_sum
+        if not spread_pods:
+            return []
+        compiler = self._affinity_compiler(snapshot, ct)
+        plugin = next(p for p in fwk.filter_plugins
+                      if p.NAME == "PodTopologySpread")
+
+        first_pi, first_cs = spread_pods[0][1], spread_pods[0][2]
+        ns = first_pi.namespace
+        tpl_key = repr((sorted((c.get("topologyKey", ""),
+                                repr(c.get("labelSelector")),
+                                c.get("maxSkew", 1)) for c in first_cs), ns))
+        eligible = (self.solver_name != "auction"
+                    and not ctx.spread_poisoned
+                    and not any(c.get("namespaceSelector")
+                                or c.get("minDomains") for c in first_cs)
+                    and (ctx.spread is None or ctx.spread["key"] == tpl_key))
+        if eligible:
+            sels = [from_label_selector(c.get("labelSelector"))
+                    for c in first_cs]
+            for i, pi, cs in spread_pods:
+                if pi.namespace != ns or repr((sorted(
+                        (c.get("topologyKey", ""),
+                         repr(c.get("labelSelector")),
+                         c.get("maxSkew", 1)) for c in cs), ns)) != tpl_key:
+                    eligible = False
+                    break
+                if not all(s.matches(pi.labels) for s in sels):
+                    eligible = False
+                    break
+                if not compiler.eligibility_row(pi)[: ct.n_real].all():
+                    eligible = False
+                    break
+            if eligible and ctx.spread is None:
+                # A selector-matching pod WITHOUT the template constraints
+                # — in ANY chunk of this assign(), not just this one —
+                # would change domain counts invisibly to the scan (chunks
+                # without spread pods never re-enter this function, and
+                # in-flight chunks can't be retro-checked). All chunks are
+                # known up front, so gate the template on the whole batch
+                # ONCE, at build time.
+                for chunk in ctx.chunks:
+                    for pj in chunk:
+                        if pj.namespace != ns or not any(
+                                s.matches(pj.labels) for s in sels):
+                            continue
+                        cs_j = plugin._constraints_for(pj, "DoNotSchedule")
+                        if repr((sorted((c.get("topologyKey", ""),
+                                         repr(c.get("labelSelector")),
+                                         c.get("maxSkew", 1))
+                                        for c in cs_j), ns)) != tpl_key:
+                            eligible = False
+                            break
+                    if not eligible:
+                        break
+
+        if eligible and ctx.spread is None:
+            # Build the template's device tensors once per assign().
+            slices = [compiler.topo.domains(c["topologyKey"])
+                      for c in first_cs]
+            D = sum(num - 1 for _, num in slices)
+            if D == 0:
+                eligible = False  # no domains at all → host path
+            else:
+                N = ct.n_pad
+                dom_onehot = np.zeros((N, D), dtype=np.float32)
+                cid_onehot = np.zeros((D, len(first_cs)), dtype=np.float32)
+                counts0 = np.zeros((D,), dtype=np.float32)
+                val_maps: list[dict] = []
+                g = 0
+                for cidx, (dom_ids, num) in enumerate(slices):
+                    counts = compiler.counts_for(
+                        first_cs[cidx].get("labelSelector"), (ns,))
+                    d = _seg_sum(np.where(dom_ids > 0, counts, 0.0),
+                                 dom_ids, num)
+                    vmap: dict = {}
+                    tk = first_cs[cidx]["topologyKey"]
+                    for k in range(1, num):
+                        members = dom_ids == k
+                        dom_onehot[members, g] = 1.0
+                        cid_onehot[g, cidx] = 1.0
+                        counts0[g] = d[k]
+                        rep = int(np.argmax(members[: ct.n_real]))
+                        vmap[snapshot.nodes[rep].labels.get(tk)] = g
+                        g += 1
+                    val_maps.append(vmap)
+                # Same-assign placements accepted before the template
+                # existed still count.
+                sels = [from_label_selector(c.get("labelSelector"))
+                        for c in first_cs]
+                for dpi, dlabels in ctx.delta:
+                    if dpi.namespace != ns:
+                        continue
+                    for cidx, c in enumerate(first_cs):
+                        if sels[cidx].matches(dpi.labels):
+                            gi = val_maps[cidx].get(
+                                dlabels.get(c["topologyKey"]))
+                            if gi is not None:
+                                counts0[gi] += 1.0
+                ctx.spread = {
+                    "key": tpl_key,
+                    "dom_onehot_host": dom_onehot,
+                    "val_maps": val_maps,
+                    "cons": first_cs, "ns": ns,
+                    "dev_dom": self._put(dom_onehot, "nodes_mat"),
+                    "dev_cid": self._put(cid_onehot),
+                    "dev_skew": self._put(np.array(
+                        [float(c.get("maxSkew", 1)) for c in first_cs],
+                        np.float32)),
+                    "dev_counts": self._put(counts0),
+                }
+
+        if eligible:
+            return [i for i, _, _ in spread_pods]
+
+        # Fallback: poison + host rows + stateful verify (the pre-template
+        # behavior). In-flight scan-trusted chunks get host re-checked at
+        # verify time via the poisoned flag.
+        ctx.spread_poisoned = True
+        for i, pi, cs in spread_pods:
+            if not any(c.get("namespaceSelector") for c in cs):
+                row = compiler.spread_filter_row(pi, cs)[: ct.n_real]
+                if not row.all():
+                    apply_row("PodTopologySpread", i, row)
+                stateful_pods.add(i)
+            else:
+                state = dyn_states.setdefault(i, CycleState())
+                row = self._dynamic_filter_row(
+                    plugin, pi, ctx.snapshot, ct, state)
+                if row is not None:
+                    apply_row("PodTopologySpread", i, row)
+                    stateful_pods.add(i)
+        return []
+
     def _dynamic_filter_row(self, plugin, pi: PodInfo, snapshot: Snapshot,
                             ct: ClusterTensors,
                             state: CycleState) -> np.ndarray | None:
@@ -501,6 +673,11 @@ class TPUBackend:
         ctx.delta_has_terms = False
         ctx.sel_cache = {}
         ctx.wsnap = None
+        # Device-side PodTopologySpread template (homogeneous batches):
+        # built lazily by _process_spread_pods; poisoned = fall back to
+        # host verification for spread from then on.
+        ctx.spread = None
+        ctx.spread_poisoned = False
         ctx.params = self._fwk_params(fwk, ct)
         # Fresh used-state upload (ONE packed array, ~80 KB) per call;
         # chunks chain on device from here.
@@ -594,6 +771,9 @@ class TPUBackend:
         # stateful irregular plugins (per pod, Skip-gated).
         dyn_states: dict[int, CycleState] = {}
         nrt_memo: dict[int, tuple] = {}
+        #: hard-spread pods deferred for template detection (see
+        #: _process_spread_pods): (chunk index, PodInfo, constraints).
+        spread_pods: list[tuple[int, PodInfo, list[dict]]] = []
         host_filter_fail: dict[str, np.ndarray] = {}  # plugin -> (P,N) ok-mask
         #: pods whose NON-affinity stateful filter gate fired (full host
         #: re-verification). Affinity-handled pods are covered by the cheap
@@ -656,15 +836,8 @@ class TPUBackend:
                             pi, "DoNotSchedule")
                         if not constraints:
                             continue  # gate was conservative; nothing to do
-                        if not any(c.get("namespaceSelector")
-                                   for c in constraints):
-                            compiler = self._affinity_compiler(snapshot, ct)
-                            row = compiler.spread_filter_row(
-                                pi, constraints)[: ct.n_real]
-                            if not row.all():
-                                apply_row(plugin.NAME, i, row)
-                            stateful_pods.add(i)
-                            continue
+                        spread_pods.append((i, pi, constraints))
+                        continue
                     state = dyn_states.setdefault(i, CycleState())
                     row = self._dynamic_filter_row(plugin, pi, snapshot, ct, state)
                     if row is not None:
@@ -675,6 +848,13 @@ class TPUBackend:
                     # means the plugin itself skipped after all.
                     if plugin.NAME != "NodePorts" and row is not None:
                         stateful_pods.add(i)
+
+        spread_active_idx = self._process_spread_pods(
+            spread_pods, pods, ctx, snapshot, ct, apply_row, stateful_pods,
+            dyn_states, fwk)
+        spread_vec = np.zeros((P,), dtype=np.bool_)
+        for i in spread_active_idx:
+            spread_vec[i] = True
 
         # Host score rows: computed over each pod's *feasible* node set only
         # (PreScore/Score receive filtered nodes in the reference), then the
@@ -751,12 +931,30 @@ class TPUBackend:
                                 host_scores[i, feas] += w * norm
                                 scores_modified = True
                             continue
-                    if name == "InterPodAffinity" and \
-                            not self._ipa_score_relevant(pi, snapshot):
-                        # No preferred terms anywhere and no hard-affinity
-                        # symmetry sources → every score is 0; skip the
-                        # O(N × residents) walk that would prove it.
-                        continue
+                    if name == "InterPodAffinity":
+                        if not self._ipa_score_relevant(pi, snapshot):
+                            # No preferred terms anywhere and no
+                            # hard-affinity symmetry sources → every score
+                            # is 0; skip the O(N × residents) walk.
+                            continue
+                        compiler = self._affinity_compiler(snapshot, ct)
+                        if compiler.score_supported(pi):
+                            feas = feasible_idx(i)
+                            feas_mask = np.zeros((ct.n_pad,), dtype=np.bool_)
+                            feas_mask[feas] = True
+                            raw_row = compiler.score_row(
+                                pi, float(getattr(
+                                    plugin, "hard_pod_affinity_weight", 1)),
+                                feas_mask)[: ct.n_real]
+                            if feas.size:
+                                vals = raw_row[feas]
+                                mx, mn = vals.max(), vals.min()
+                                if mx > mn:
+                                    norm = 100.0 * (vals - mn) / (mx - mn)
+                                    host_scores[i, feas] += w * norm
+                                    scores_modified = True
+                            continue
+                        # namespaceSelector terms → host slow path below.
                     state = dyn_states.setdefault(i, CycleState())
                     nodes_i = [snapshot.nodes[j] for j in feasible_idx(i)]
                     st = plugin.pre_score(state, pi, nodes_i)
@@ -792,6 +990,7 @@ class TPUBackend:
             "dev_mask": dev_mask, "dev_scores": dev_scores,
             "host_filter_fail": host_filter_fail,
             "unknown_res": unknown_res, "stateful_pods": stateful_pods,
+            "spread_active_idx": spread_active_idx, "spread_vec": spread_vec,
         }
 
     def _dispatch_chunk(self, prep: dict, ctx: "_AssignCtx") -> dict:
@@ -814,16 +1013,29 @@ class TPUBackend:
             [batch.req_q, batch.req_nz_q,
              batch.untol_filter.astype(np.int32),
              batch.untol_prefer.astype(np.int32)], axis=1)
-        assign_d, used_pack2, fit0_d, taint_ok_d = _mask_solve_update(
-            self._dev_static["alloc_q"], self._dev_used,
-            self._dev_static["alloc_pods"], self._put(pod_pack),
-            self._dev_static["taint_f"], self._dev_static["taint_p"],
-            prep["dev_mask"], prep["dev_scores"],
-            p["fit_col_w"], p["bal_col_mask"], p["shape_u"], p["shape_s"],
-            p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
-            p["strategy"], self.solver_name == "auction",
-        )
+        sp = ctx.spread
+        use_spread = bool(sp is not None and prep["spread_active_idx"]
+                          and not ctx.spread_poisoned)
+        prep["spread_used"] = use_spread
+        if use_spread:
+            sp_args = (sp["dev_dom"], sp["dev_cid"], sp["dev_counts"],
+                       sp["dev_skew"], self._put(prep["spread_vec"]))
+        else:
+            sp_args = self._spread_dummies(ct.n_pad, prep["spread_vec"].shape[0])
+        assign_d, used_pack2, fit0_d, taint_ok_d, dom_counts2 = \
+            _mask_solve_update(
+                self._dev_static["alloc_q"], self._dev_used,
+                self._dev_static["alloc_pods"], self._put(pod_pack),
+                self._dev_static["taint_f"], self._dev_static["taint_p"],
+                prep["dev_mask"], prep["dev_scores"],
+                p["fit_col_w"], p["bal_col_mask"], p["shape_u"], p["shape_s"],
+                p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
+                *sp_args,
+                p["strategy"], self.solver_name == "auction", use_spread,
+            )
         self._dev_used = used_pack2
+        if use_spread:
+            sp["dev_counts"] = dom_counts2
         # Start the device→host copy now; the fetch in _finalize_chunk then
         # overlaps the next chunk's solve (and, in assign_async, bind tasks).
         try:
@@ -842,8 +1054,14 @@ class TPUBackend:
 
         # Host verify + working-state accumulation (hard part #1). The
         # verify context is shared across chunks, so later chunks are
-        # checked against earlier chunks' accepted placements.
-        rejects = self._verify(pods, assign, ctx, run["stateful_pods"])
+        # checked against earlier chunks' accepted placements. Scan-trusted
+        # spread pods skip the host re-check — UNLESS the template was
+        # poisoned after this chunk was dispatched (a mixed chunk appeared):
+        # then they re-enter the stateful set, restoring exactness.
+        stateful = run["stateful_pods"]
+        if ctx.spread_poisoned and run.get("spread_used"):
+            stateful = set(stateful) | set(run["spread_active_idx"])
+        rejects = self._verify(pods, assign, ctx, stateful)
 
         # Fold verify rejections back into the device-chained used-state so
         # later chunks don't see the rejected pods' resources as consumed.
@@ -860,6 +1078,21 @@ class TPUBackend:
                 used[idx, r:2 * r] -= batch.req_nz_q[i]
                 used[idx, 2 * r] -= 1
             self._dev_used = self._put(used, "nodes_mat")
+            # Spread-active rejects also fold out of the chained domain
+            # counts (adds commute, same argument as the used-state).
+            sp = ctx.spread
+            if sp is not None and run.get("spread_used"):
+                active = set(run["spread_active_idx"])
+                adj = None
+                for i, idx in rejects:
+                    if i in active:
+                        if adj is None:
+                            adj = np.zeros(
+                                sp["dom_onehot_host"].shape[1], np.float32)
+                        adj -= sp["dom_onehot_host"][idx]
+                if adj is not None:
+                    sp["dev_counts"] = self._put(
+                        np.asarray(sp["dev_counts"]) + adj)
 
         # Lazy per-plugin diagnostics for unassigned pods.
         need_diag = [i for i, pi in enumerate(pods)
@@ -1081,7 +1314,7 @@ class _AssignCtx:
     __slots__ = ("snapshot", "fwk", "ct", "chunks", "params",
                  "assignments", "diagnostics",
                  "working", "delta", "delta_has_terms", "sel_cache",
-                 "wsnap")
+                 "wsnap", "spread", "spread_poisoned")
 
 
 def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict):

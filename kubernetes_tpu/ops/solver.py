@@ -115,6 +115,82 @@ def greedy_assign_rescoring(req_q, req_nz_q, free_q, free_pods, used_nz_q,
     return assign
 
 
+@partial(jax.jit, static_argnames=("strategy",))
+def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
+                                   used_nz_q, alloc_q, mask, static_scores,
+                                   fit_col_w, bal_col_mask, shape_u, shape_s,
+                                   w_fit, w_bal, strategy: str,
+                                   dom_onehot, cid_onehot, dom_counts,
+                                   max_skew, spread_active):
+    """greedy_assign_rescoring + PodTopologySpread hard constraints INSIDE
+    the scan (sequential-equivalent, like capacity).
+
+    The batch-then-verify split is pathological for tight `maxSkew`: the
+    solver's batch-start masks let every pod into one domain, the host
+    verify rejects all but ~(domains × maxSkew) per batch, and throughput
+    collapses to a requeue loop. For the homogeneous-template case (every
+    spread-constrained pod in the batch shares one constraint set and
+    matches its own selectors — the perf-family / gang shape), the domain
+    counts ride the scan carry instead:
+
+    dom_onehot: (N, D) float32 — node → domain one-hot over the union of
+        the template's constraints' domains (eligible nodes only; a node
+        missing a constraint's topology key has no domain for it and is
+        rejected, DoNotSchedule semantics).
+    cid_onehot: (D, C) float32 — domain → owning constraint.
+    dom_counts: (D,) float32 — batch-start matching-pod count per domain.
+    max_skew:   (C,) float32 per constraint.
+    spread_active: (P,) bool — pods that participate (check + count).
+
+    Returns (assign, dom_counts') so the caller can chain counts across
+    chunks on device, exactly like the packed used-state.
+    """
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = free_q.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    big = jnp.float32(1e30)
+
+    def step(carry, inp):
+        free_q, free_pods, used_nz, dcounts = carry
+        req, req_nz, m, sc_static, active = inp
+        # min count over each constraint's domains (empty domains included).
+        min_c = jnp.min(
+            jnp.where(cid_onehot > 0, dcounts[:, None], big), axis=0)  # (C,)
+        allowed_d = (dcounts + 1.0 - cid_onehot @ min_c) \
+            <= (cid_onehot @ max_skew)                                 # (D,)
+        node_c_ok = (dom_onehot @ (allowed_d[:, None] * cid_onehot)) > 0
+        # Every constraint: the node must belong to one of its domains
+        # (has_key, DoNotSchedule rejects keyless nodes) AND that domain's
+        # skew must allow one more pod. A node has ≤1 domain per
+        # constraint, so membership-in-allowed covers both.
+        spread_ok = jnp.all(node_c_ok, axis=1)
+        fits = m & jnp.all(req[None, :] <= free_q, axis=1) & (free_pods >= 1)
+        fits = fits & (spread_ok | ~active)
+        any_fit = jnp.any(fits)
+        sc = sc_static
+        sc = sc + w_fit * kernels.fit_score(
+            alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
+            shape_u, shape_s)[0]
+        sc = sc + w_bal * kernels.balanced_allocation_score(
+            alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
+        masked = jnp.where(fits, sc, NEG_INF)
+        idx = jnp.argmax(masked).astype(jnp.int32)
+        idx = jnp.where(any_fit, idx, jnp.int32(-1))
+        hit = iota == idx
+        free_q = free_q - jnp.where(hit[:, None], req[None, :], 0)
+        free_pods = free_pods - hit.astype(jnp.int32)
+        used_nz = used_nz + jnp.where(hit[:, None], req_nz[None, :], 0)
+        dcounts = dcounts + jnp.where(
+            any_fit & active, hit.astype(jnp.float32) @ dom_onehot, 0.0)
+        return (free_q, free_pods, used_nz, dcounts), idx
+
+    (_, _, _, dom_counts2), assign = lax.scan(
+        step, (free_q, free_pods, used_nz_q, dom_counts),
+        (req_q, req_nz_q, mask, static_scores, spread_active))
+    return assign, dom_counts2
+
+
 @partial(jax.jit, static_argnames=("rounds",))
 def auction_assign(req_q, free_q, free_pods, mask, scores, rounds: int = 16):
     """Auction rounds for contention-heavy batches.

@@ -102,30 +102,57 @@ class PerfRunner:
 
     def __init__(self, backend=None, batch_size: int = 1,
                  scheduler_kwargs: Mapping | None = None,
-                 scheduler_config: Mapping | None = None):
+                 scheduler_config: Mapping | None = None,
+                 through_apiserver: bool = False):
         self.backend = backend
         self.batch_size = batch_size
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
         #: Optional inline KubeSchedulerConfiguration (a workload family may
         #: enable non-default plugins, e.g. NodeResourceTopologyMatch).
         self.scheduler_config = scheduler_config
+        #: Cross the process boundary like the reference's scheduler_perf
+        #: (in-process apiserver + REAL wire): all traffic — workload
+        #: writes, the scheduler's informers, and binding POSTs — goes over
+        #: the HTTP apiserver instead of direct store calls.
+        self.through_apiserver = through_apiserver
 
     async def run(self, template_ops: list, params: Mapping[str, Any],
                   timeout: float = 600.0) -> WorkloadResult:
-        store = new_cluster_store()
-        install_core_validation(store)
-        metrics = SchedulerMetrics()
-        profiles = None
-        if self.scheduler_config is not None:
-            from kubernetes_tpu.config.scheduler import load_config
-            cfg = load_config(self.scheduler_config)
-            profiles = {p.scheduler_name: p.build_framework(
-                store=store, metrics=metrics) for p in cfg.profiles}
-        sched = Scheduler(store, seed=42, backend=self.backend,
-                          metrics=metrics, profiles=profiles,
-                          **self.scheduler_kwargs)
-        factory = InformerFactory(store)
-        await sched.setup_informers(factory)
+        backing = new_cluster_store()
+        install_core_validation(backing)
+        server = None
+        client = None
+        try:
+            if self.through_apiserver:
+                from kubernetes_tpu.apiserver.client import RemoteStore
+                from kubernetes_tpu.apiserver.server import APIServer
+                server = APIServer(backing)
+                await server.start()
+                client = RemoteStore(server.url)
+                store = client
+            else:
+                store = backing
+            metrics = SchedulerMetrics()
+            profiles = None
+            if self.scheduler_config is not None:
+                from kubernetes_tpu.config.scheduler import load_config
+                cfg = load_config(self.scheduler_config)
+                profiles = {p.scheduler_name: p.build_framework(
+                    store=store, metrics=metrics) for p in cfg.profiles}
+            sched = Scheduler(store, seed=42, backend=self.backend,
+                              metrics=metrics, profiles=profiles,
+                              **self.scheduler_kwargs)
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+        except BaseException:
+            # Setup failed after the server/client came up — don't leak
+            # the bound socket or background tasks.
+            if client is not None:
+                await client.close()
+            if server is not None:
+                await server.stop()
+            backing.stop()
+            raise
 
         # Bound-pod accounting via watch events, not store LISTs: a LIST
         # deep-copies every object and was the harness's own hot spot.
@@ -182,30 +209,50 @@ class PerfRunner:
                         # Metric window starts now: percentiles and
                         # throughput cover only the measured phase (warmup
                         # attempts — including jit compile — are excluded).
-                        hist_base = metrics.attempt_duration.snapshot(
-                            result="scheduled", profile="default-scheduler")
-                        t0 = time.monotonic()
-                    for i in range(count):
-                        await store.create("pods", make_pod(
-                            f"pod-{pod_seq + i}", **copy.deepcopy(tmpl)))
+                        window = self._begin_measure(metrics)
+                    names = [f"pod-{pod_seq + i}" for i in range(count)]
+                    # Writes go out in concurrent windows (the reference
+                    # harness drives the apiserver with multi-goroutine
+                    # client QPS; serial awaits would make the HTTP
+                    # boundary the benchmark).
+                    for lo in range(0, count, 128):
+                        await asyncio.gather(*(
+                            store.create("pods", make_pod(
+                                name, **copy.deepcopy(tmpl)))
+                            for name in names[lo:lo + 128]))
                     pod_seq += count
                     created_total += count
                     if measured:
+                        # Scoped to THIS op's pods (reference barriers take
+                        # a labelSelector for the same reason): preemption
+                        # deletes victims, so the global count can shrink.
+                        pod_ns = tmpl.get("namespace", "default")
+                        want = {f"{pod_ns}/{n}" for n in names}
+                        await self._wait_keys(bound_keys, want, deadline)
+                        self._end_measure(result, metrics, window, count)
+
+                elif opcode == "ungatePods":
+                    # Strip schedulingGates from every gated pod (the
+                    # reference's gated-pods workload: a controller lifts
+                    # the gate; PreEnqueue re-admits). Measured variant
+                    # times gate-removal → all bound.
+                    measured = bool(op.get("collectMetrics"))
+                    if measured:
+                        window = self._begin_measure(metrics)
+                    gated = [p for p in (await store.list("pods")).items
+                             if p["spec"].get("schedulingGates")]
+
+                    def strip(obj):
+                        obj["spec"].pop("schedulingGates", None)
+                        return obj
+                    for p in gated:
+                        await store.guaranteed_update(
+                            "pods", namespaced_name(p), strip)
+                    if measured:
                         await self._wait_bound(bound_keys, created_total,
                                                deadline)
-                        dt = time.monotonic() - t0
-                        result.measured_pods = count
-                        result.measured_seconds = dt
-                        result.throughput = count / dt if dt > 0 else 0.0
-                        h = metrics.attempt_duration
-                        labels = {"result": "scheduled",
-                                  "profile": "default-scheduler"}
-                        result.attempt_p50 = h.percentile_since(
-                            0.50, hist_base, **labels)
-                        result.attempt_p90 = h.percentile_since(
-                            0.90, hist_base, **labels)
-                        result.attempt_p99 = h.percentile_since(
-                            0.99, hist_base, **labels)
+                        self._end_measure(result, metrics, window,
+                                          len(gated))
 
                 elif opcode == "barrier":
                     await self._wait_bound(bound_keys, created_total, deadline)
@@ -241,7 +288,11 @@ class PerfRunner:
             await sched.stop()
             run_task.cancel()
             factory.stop()
-            store.stop()
+            if client is not None:
+                await client.close()
+            if server is not None:
+                await server.stop()
+            backing.stop()
 
         # Percentiles were captured over the measured window above
         # (scheduler_scheduling_attempt_duration_seconds — SURVEY §5.5);
@@ -257,6 +308,26 @@ class PerfRunner:
         result.fragmentation_pct = self._fragmentation(sched)
         return result
 
+    @staticmethod
+    def _begin_measure(metrics: SchedulerMetrics) -> tuple:
+        return (metrics.attempt_duration.snapshot(
+            result="scheduled", profile="default-scheduler"),
+            time.monotonic())
+
+    @staticmethod
+    def _end_measure(result: WorkloadResult, metrics: SchedulerMetrics,
+                     window: tuple, count: int) -> None:
+        hist_base, t0 = window
+        dt = time.monotonic() - t0
+        result.measured_pods = count
+        result.measured_seconds = dt
+        result.throughput = count / dt if dt > 0 else 0.0
+        h = metrics.attempt_duration
+        labels = {"result": "scheduled", "profile": "default-scheduler"}
+        result.attempt_p50 = h.percentile_since(0.50, hist_base, **labels)
+        result.attempt_p90 = h.percentile_since(0.90, hist_base, **labels)
+        result.attempt_p99 = h.percentile_since(0.99, hist_base, **labels)
+
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:
         """barrierOp: block until every created pod has a nodeName."""
@@ -266,6 +337,17 @@ class PerfRunner:
             await asyncio.sleep(0.01)
         raise TimeoutError(
             f"barrier: {len(bound_keys)}/{want} pods bound at timeout")
+
+    @staticmethod
+    async def _wait_keys(bound_keys: set, want: set,
+                         deadline: float) -> None:
+        """Scoped barrier: block until a specific key set is bound."""
+        while time.monotonic() < deadline:
+            if want <= bound_keys:
+                return
+            await asyncio.sleep(0.01)
+        missing = len(want - bound_keys)
+        raise TimeoutError(f"scoped barrier: {missing} pods unbound at timeout")
 
     @staticmethod
     def _fragmentation(sched: Scheduler) -> float:

@@ -13,6 +13,8 @@ The dry-run uses cloned NodeInfo so the live snapshot is untouched.
 
 from __future__ import annotations
 
+import random
+
 from typing import Mapping
 
 from kubernetes_tpu.scheduler.framework import (
@@ -35,6 +37,7 @@ class DefaultPreemption(Plugin):
         super().__init__(args)
         self.framework = framework
         self.evict = evict
+        self._rng = random.Random(self.args.get("seed", 0))
 
     def post_filter(self, state: CycleState, pod: PodInfo, snapshot: Snapshot,
                     filtered_status: Mapping[str, Status]) -> tuple[str, Status]:
@@ -80,10 +83,14 @@ class DefaultPreemption(Plugin):
             victims.append(v)
         return victims if victims else None
 
-    @staticmethod
-    def _pick_one(candidates: list[tuple[str, list[PodInfo]]]) -> tuple[str, list[PodInfo]]:
+    def _pick_one(self, candidates: list[tuple[str, list[PodInfo]]]
+                  ) -> tuple[str, list[PodInfo]]:
         """pickOneNodeForPreemption cost ordering (no PDB tier yet —
-        disruption controller integration adds it)."""
+        disruption controller integration adds it). Ties break RANDOMLY
+        (seeded): the reference scans a Go map whose iteration order is
+        randomized, which spreads concurrent preemptors across equal-cost
+        nodes — a deterministic first-min made every preemptor in a wave
+        nominate the SAME node and retry quadratically."""
         def cost(entry):
             _, victims = entry
             return (
@@ -91,4 +98,6 @@ class DefaultPreemption(Plugin):
                 sum(v.priority for v in victims),
                 len(victims),
             )
-        return min(candidates, key=cost)
+        best = min(cost(e) for e in candidates)
+        ties = [e for e in candidates if cost(e) == best]
+        return ties[self._rng.randrange(len(ties))]

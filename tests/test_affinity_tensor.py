@@ -150,3 +150,99 @@ class TestBackendAffinityWorkload:
         assert len(set(nodes_used)) == 6
         unassigned = [p for p in pods if assignments[p.key] is None]
         assert len(unassigned) == 1
+
+
+class TestDeviceSpreadScan:
+    """PodTopologySpread hard constraints enforced INSIDE the device scan
+    for homogeneous batches (solver.greedy_assign_rescoring_spread)."""
+
+    def _spread_pods(self, count, start=0):
+        cons = [{"maxSkew": 1, "topologyKey": ZONE,
+                 "whenUnsatisfiable": "DoNotSchedule",
+                 "labelSelector": {"matchLabels": {"app": "s"}}}]
+        return [PodInfo(make_pod(
+            f"s{start + i}", labels={"app": "s"},
+            requests={"cpu": "100m"}, uid=f"su{start + i}",
+            topology_spread_constraints=cons)) for i in range(count)]
+
+    def _cluster(self, nodes_per_zone=3):
+        cache = SchedulerCache()
+        n = 0
+        for z in ZONES:
+            for _ in range(nodes_per_zone):
+                cache.add_node(make_node(f"n{n}", labels={ZONE: z}))
+                n += 1
+        return cache
+
+    def test_batch_respects_max_skew(self):
+        from kubernetes_tpu.ops import TPUBackend
+        from kubernetes_tpu.scheduler.framework import Framework
+        from kubernetes_tpu.scheduler.plugins.registry import (
+            DEFAULT_SCORE_WEIGHTS, build_plugins)
+        cache = self._cluster()
+        snapshot = cache.update_snapshot()
+        pods = self._spread_pods(30)
+        fwk = Framework(build_plugins(), DEFAULT_SCORE_WEIGHTS)
+        backend = TPUBackend(max_batch=32)
+        assignments, _ = backend.assign(pods, snapshot, fwk)
+        zone_of = {f"n{i}": ZONES[i // 3] for i in range(9)}
+        counts = {z: 0 for z in ZONES}
+        for p in pods:
+            assert assignments[p.key] is not None
+            counts[zone_of[assignments[p.key]]] += 1
+        # One batch, maxSkew=1 → zones within 1 of each other.
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_cross_chunk_counts_chain(self):
+        """Chunks chain domain counts on device: a second chunk sees the
+        first chunk's placements."""
+        from kubernetes_tpu.ops import TPUBackend
+        from kubernetes_tpu.scheduler.framework import Framework
+        from kubernetes_tpu.scheduler.plugins.registry import (
+            DEFAULT_SCORE_WEIGHTS, build_plugins)
+        cache = self._cluster()
+        snapshot = cache.update_snapshot()
+        pods = self._spread_pods(24)
+        fwk = Framework(build_plugins(), DEFAULT_SCORE_WEIGHTS)
+        backend = TPUBackend(max_batch=8)  # 3 chunks
+        assignments, _ = backend.assign(pods, snapshot, fwk)
+        zone_of = {f"n{i}": ZONES[i // 3] for i in range(9)}
+        counts = {z: 0 for z in ZONES}
+        for p in pods:
+            assert assignments[p.key] is not None
+            counts[zone_of[assignments[p.key]]] += 1
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_mixed_batch_poisons_to_host_path(self):
+        """A batch with two DIFFERENT spread templates falls back to the
+        host verify path and still never violates either constraint."""
+        from kubernetes_tpu.ops import TPUBackend
+        from kubernetes_tpu.scheduler.framework import Framework
+        from kubernetes_tpu.scheduler.plugins.registry import (
+            DEFAULT_SCORE_WEIGHTS, build_plugins)
+        cache = self._cluster()
+        snapshot = cache.update_snapshot()
+        pods = self._spread_pods(9)
+        other_cons = [{"maxSkew": 2, "topologyKey": ZONE,
+                       "whenUnsatisfiable": "DoNotSchedule",
+                       "labelSelector": {"matchLabels": {"app": "t"}}}]
+        pods += [PodInfo(make_pod(
+            f"t{i}", labels={"app": "t"}, requests={"cpu": "100m"},
+            uid=f"tu{i}", topology_spread_constraints=other_cons))
+            for i in range(6)]
+        fwk = Framework(build_plugins(), DEFAULT_SCORE_WEIGHTS)
+        backend = TPUBackend(max_batch=32)
+        assignments, _ = backend.assign(pods, snapshot, fwk)
+        zone_of = {f"n{i}": ZONES[i // 3] for i in range(9)}
+        s_counts = {z: 0 for z in ZONES}
+        t_counts = {z: 0 for z in ZONES}
+        for p in pods:
+            node = assignments[p.key]
+            if node is None:
+                continue
+            if p.labels["app"] == "s":
+                s_counts[zone_of[node]] += 1
+            else:
+                t_counts[zone_of[node]] += 1
+        assert max(s_counts.values()) - min(s_counts.values()) <= 1
+        assert max(t_counts.values()) - min(t_counts.values()) <= 2

@@ -90,3 +90,58 @@ class TestSuiteConfig:
         names = {c["name"] for c in cfg}
         assert {"SchedulingBasic", "SchedulingNodeAffinity",
                 "SchedulingTaints", "Unschedulable"} <= names
+
+
+class TestNewFamilies:
+    def test_repo_config_has_all_reference_families(self):
+        """SURVEY §3.5's workload family list is fully present."""
+        from kubernetes_tpu.perf.scheduler_perf import load_config
+        cfg = load_config("kubernetes_tpu/perf/config/performance-config.yaml")
+        names = {c["name"] for c in cfg}
+        assert {"SchedulingPodAffinity", "TopologySpreading", "Preemption",
+                "SchedulingGated", "DeviceTopology",
+                "SchedulingPodAntiAffinity"} <= names
+
+    def test_ungate_pods_opcode(self):
+        """Gated pods park in the gated tier; ungatePods lifts the gates and
+        the measured window covers gate-removal → bound."""
+        template = [
+            {"opcode": "createNodes", "count": 5},
+            {"opcode": "createPods", "count": 12,
+             "podTemplate": {"scheduling_gates": ["hold"]}},
+            {"opcode": "sleep", "duration": 0.2},
+            {"opcode": "ungatePods", "collectMetrics": True},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {}, timeout=30.0))
+        assert res.scheduled_total == 12
+        assert res.measured_pods == 12
+        assert res.throughput > 0
+
+    def test_preemption_family_scoped_barrier(self):
+        """High-priority pods preempt a full cluster; the measured op's
+        scoped barrier completes even though victims are deleted."""
+        template = [
+            {"opcode": "createNodes", "count": 4,
+             "nodeTemplate": {"allocatable":
+                              {"cpu": "2", "memory": "8Gi", "pods": "16"}}},
+            {"opcode": "createPods", "count": 8,
+             "podTemplate": {"priority": 0, "requests": {"cpu": "1"}}},
+            {"opcode": "barrier"},
+            {"opcode": "createPods", "count": 4, "collectMetrics": True,
+             "podTemplate": {"priority": 100, "requests": {"cpu": "1"}}},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {}, timeout=60.0))
+        assert res.measured_pods == 4
+        assert res.scheduled_total >= 12  # 8 fillers + 4 preemptors
+
+    def test_through_apiserver_mode(self):
+        """The whole workload crosses the HTTP process boundary."""
+        template = [
+            {"opcode": "createNodes", "count": 5},
+            {"opcode": "createPods", "count": 20, "collectMetrics": True},
+            {"opcode": "barrier"},
+        ]
+        res = asyncio.run(PerfRunner(through_apiserver=True).run(
+            template, {}, timeout=60.0))
+        assert res.scheduled_total == 20
+        assert res.unschedulable_total == 0
