@@ -58,6 +58,11 @@ DEVICE_SCORE_PLUGINS = {
 #: chunk results have no host-side dependency until verify.
 _PIPELINE_DEPTH = 2
 
+#: Gang (PodGroup) slots per chunk for the solver's all-or-nothing masking;
+#: fixed so the jit signature is stable. Overflow gangs keep the Permit
+#: barrier as their only atomicity (the reference behavior).
+_GANG_PAD = 16
+
 #: Static node-predicate plugins whose (pod-spec → node row) is cacheable by
 #: spec signature while the node set is unchanged.
 STATIC_ROW_PLUGINS = {"NodeAffinity", "NodeName", "NodeUnschedulable"}
@@ -110,15 +115,14 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
     raise KeyError(plugin_name)
 
 
-@partial(jax.jit,
-         static_argnames=("strategy", "use_auction", "use_spread"))
+@partial(jax.jit, static_argnames=("strategy", "use_spread"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                        taint_f_mat, taint_p_mat, static_mask, host_scores,
                        fit_col_w, bal_col_mask, shape_u, shape_s,
                        w_fit, w_bal, w_taint, taint_filter_on,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
-                       spread_active,
-                       strategy: str, use_auction: bool, use_spread: bool):
+                       spread_active, perms, gang_onehot, gang_required,
+                       strategy: str, use_spread: bool):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -159,24 +163,26 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     free_q = alloc_q - used_q
     free_pods = alloc_pods - used_pods
     dom_counts2 = dom_counts
-    if use_auction:
-        total = static_scores
-        total = total + w_fit * kernels.fit_score(
-            alloc_q, used_nz_q, req_nz_q, fit_col_w, strategy, shape_u, shape_s)
-        total = total + w_bal * kernels.balanced_allocation_score(
-            alloc_q, used_nz_q, req_nz_q, bal_col_mask)
-        assign = solver.auction_assign(req_q, free_q, free_pods, mask, total)
-    elif use_spread:
-        assign, dom_counts2 = solver.greedy_assign_rescoring_spread(
+    if use_spread:
+        # Spread batches run the identity order only (domain counts and
+        # permutations don't commute cheaply); gang masking still applies.
+        a0, dom_counts2 = solver.greedy_assign_rescoring_spread(
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
             static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal, strategy,
             dom_onehot, cid_onehot, dom_counts, max_skew, spread_active)
+        assign = solver.gang_filter(a0, gang_onehot, gang_required)
+        # Gang-dropped spread pods bumped the chained counts in-scan —
+        # fold them back out so later chunks see the truth.
+        dropped = (a0 >= 0) & (assign < 0) & spread_active
+        safe = jnp.clip(a0, 0, alloc_q.shape[0] - 1)
+        dom_counts2 = dom_counts2 - jnp.sum(
+            jnp.where(dropped[:, None], dom_onehot[safe], 0.0), axis=0)
     else:
-        assign = solver.greedy_assign_rescoring(
+        assign = solver.multistart_greedy_assign(
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
             static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
-            w_fit, w_bal, strategy)
+            w_fit, w_bal, strategy, perms, gang_onehot, gang_required)
 
     # Post-assignment state update (scatter-add of assigned requests).
     # Padding/unassigned rows scatter to a dummy row (index N, dropped).
@@ -195,11 +201,15 @@ class TPUBackend:
     """Batched backend: `assign(pods, snapshot, fwk)` →
     ({pod_key: node_name|None}, {pod_key: {node_name: Status}})."""
 
-    def __init__(self, max_batch: int = 128, solver_name: str = "greedy",
+    def __init__(self, max_batch: int = 128, multistart: int = 4,
                  resources: Sequence[str] | None = None,
                  mesh: object = "auto"):
         self.max_batch = max_batch
-        self.solver_name = solver_name
+        #: parallel permuted-order scans per chunk (1 = oracle-only order).
+        #: Selection: most pods placed, then most request volume placed,
+        #: identity on full ties — never fewer pods than the oracle order,
+        #: and priority-block-stable permutations keep priority fairness.
+        self.multistart = max(1, int(multistart))
         self._pinned_resources = list(resources) if resources else None
         # Multi-device: shard the nodes axis over an ICI mesh
         # (SURVEY §5.7 — the TP-like axis). Inputs are placed with
@@ -257,6 +267,11 @@ class TPUBackend:
         # Fixed-shape placeholder device arrays for the fused program's
         # spread slots when use_spread=False (stable jit signature).
         self._spread_dummy_cache: dict[tuple, tuple] = {}
+        # Device-resident permutation sets (keyed by sizes+priorities) and
+        # the all-zeros gang arrays for the common no-gang case — each
+        # host→device transfer costs relay latency regardless of size.
+        self._dev_perms_cache: dict[tuple, object] = {}
+        self._dev_zero_gang: dict[int, tuple] = {}
 
     # -- device placement ----------------------------------------------------
 
@@ -444,6 +459,20 @@ class TPUBackend:
             hit = self._row_cache[key] = (row, bool(row.any()))
         return hit
 
+    def _gang_args(self, prep: dict, batch) -> tuple:
+        """(gang_onehot, gang_required) device arrays; the no-gang case
+        reuses one cached zero pair per batch width."""
+        if prep["gang_onehot"] is not None:
+            return (self._put(prep["gang_onehot"]),
+                    self._put(prep["gang_required"]))
+        P = batch.req_q.shape[0]
+        z = self._dev_zero_gang.get(P)
+        if z is None:
+            z = self._dev_zero_gang[P] = (
+                self._put(np.zeros((P, _GANG_PAD), np.float32)),
+                self._put(np.zeros((_GANG_PAD,), np.float32)))
+        return z
+
     def _spread_dummies(self, n_pad: int, p: int) -> tuple:
         key = (n_pad, p)
         d = self._spread_dummy_cache.get(key)
@@ -481,8 +510,7 @@ class TPUBackend:
         tpl_key = repr((sorted((c.get("topologyKey", ""),
                                 repr(c.get("labelSelector")),
                                 c.get("maxSkew", 1)) for c in first_cs), ns))
-        eligible = (self.solver_name != "auction"
-                    and not ctx.spread_poisoned
+        eligible = (not ctx.spread_poisoned
                     and not any(c.get("namespaceSelector")
                                 or c.get("minDomains") for c in first_cs)
                     and (ctx.spread is None or ctx.spread["key"] == tpl_key))
@@ -985,12 +1013,89 @@ class TPUBackend:
                 dev_scores = self._dev_zero_scores[(P, N)] = \
                     self._put(host_scores, "pn")
 
+        # Multi-start orders: identity first (ties → oracle-equivalent),
+        # then size-desc / size-asc / seeded shuffles. Permutations are
+        # PRIORITY-BLOCK-STABLE: pods only move within runs of equal
+        # priority (queue order is priority order — reordering across
+        # blocks could strand a high-priority pod behind a bulkier
+        # low-priority order, a starvation the reference can't exhibit).
+        # Padding stays in place; its mask is all-False anyway.
+        K = self.multistart
+        pr = batch.p_real
+        if K > 1 and pr > 1:
+            sizes = batch.req_q[:pr].sum(axis=1)
+            prios = np.fromiter((p.priority for p in pods), dtype=np.int64,
+                                count=pr)
+            perms_key = (K, P, pr, sizes.tobytes(), prios.tobytes())
+        else:
+            perms_key = (K, P)
+        dev_perms = self._dev_perms_cache.get(perms_key)
+        if dev_perms is None:
+            perms = np.tile(np.arange(P, dtype=np.int32), (K, 1))
+            if K > 1 and pr > 1:
+                blocks = []
+                lo = 0
+                for hi in range(1, pr + 1):
+                    if hi == pr or prios[hi] != prios[lo]:
+                        blocks.append((lo, hi))
+                        lo = hi
+                rng = np.random.default_rng(0xC0FFEE + pr)
+
+                def fill(k, order_of):
+                    for lo, hi in blocks:
+                        perms[k, lo:hi] = lo + order_of(lo, hi)
+                if K > 1:
+                    fill(1, lambda lo, hi: np.argsort(
+                        -sizes[lo:hi], kind="stable").astype(np.int32))
+                if K > 2:
+                    fill(2, lambda lo, hi: np.argsort(
+                        sizes[lo:hi], kind="stable").astype(np.int32))
+                for k in range(3, K):
+                    fill(k, lambda lo, hi: rng.permutation(
+                        hi - lo).astype(np.int32))
+            dev_perms = self._put(perms)
+            if len(self._dev_perms_cache) > 64:
+                self._dev_perms_cache.clear()
+            self._dev_perms_cache[perms_key] = dev_perms
+
+        # Gang membership (Coscheduling): all-or-nothing inside the solve.
+        # The quota is what the gang still NEEDS: minMember minus members
+        # already assembled (bound or parked at Permit) — a fully-assembled
+        # gang's stragglers place individually, like the Permit path.
+        gang_onehot = None
+        gang_required = None
+        cosched = next(
+            (pl for pl in fwk.plugins if pl.NAME == "Coscheduling"), None)
+        if cosched is not None and getattr(cosched, "pg_informer", None) \
+                is not None:
+            groups: dict[str, list[int]] = {}
+            for i, pi in enumerate(pods):
+                gk = cosched.group_key(pi)
+                if gk:
+                    groups.setdefault(gk, []).append(i)
+            if groups:
+                gang_onehot = np.zeros((P, _GANG_PAD), dtype=np.float32)
+                gang_required = np.zeros((_GANG_PAD,), dtype=np.float32)
+                for g, (gk, idxs) in enumerate(groups.items()):
+                    if g >= _GANG_PAD:
+                        break  # overflow gangs: Permit barrier only
+                    pg = cosched._pod_group(gk)
+                    mm = int(((pg or {}).get("spec") or {})
+                             .get("minMember", 1))
+                    assembled = len(cosched._bound.get(gk) or ()) + \
+                        len(cosched._waiting.get(gk) or ())
+                    for i in idxs:
+                        gang_onehot[i, g] = 1.0
+                    gang_required[g] = min(max(mm - assembled, 0), len(idxs))
+
         return {
             "pods": pods, "batch": batch,
             "dev_mask": dev_mask, "dev_scores": dev_scores,
             "host_filter_fail": host_filter_fail,
             "unknown_res": unknown_res, "stateful_pods": stateful_pods,
             "spread_active_idx": spread_active_idx, "spread_vec": spread_vec,
+            "dev_perms": dev_perms, "gang_onehot": gang_onehot,
+            "gang_required": gang_required,
         }
 
     def _dispatch_chunk(self, prep: dict, ctx: "_AssignCtx") -> dict:
@@ -1031,7 +1136,8 @@ class TPUBackend:
                 p["fit_col_w"], p["bal_col_mask"], p["shape_u"], p["shape_s"],
                 p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
                 *sp_args,
-                p["strategy"], self.solver_name == "auction", use_spread,
+                prep["dev_perms"], *self._gang_args(prep, batch),
+                p["strategy"], use_spread,
             )
         self._dev_used = used_pack2
         if use_spread:

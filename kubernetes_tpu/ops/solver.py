@@ -16,10 +16,10 @@ Two solvers:
   Deterministic (ties → lowest node index; the host path's seeded reservoir
   tiebreak is equivalent up to tie choice). This is the oracle-equivalent
   default.
-- `auction_assign` — Bertsekas-style auction rounds (all pods bid for their
-  best node simultaneously; contested nodes raise prices) for better packing
-  under contention; falls back to greedy cleanup for unassigned pods. Used
-  when `solver="auction"`.
+- `multistart_greedy_assign` — the contention solver: the SAME scan under
+  K pod orders in parallel (vmap over permutations), gang all-or-nothing
+  masking, keep the order that places the most pods; identity order wins
+  ties so uncontended batches equal the oracle bit-for-bit.
 
 Both are shape-static, jit-compiled once per (P, N, R) signature, and emit
 `(P,) int32` node indices with -1 = unschedulable-this-cycle.
@@ -191,60 +191,67 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
     return assign, dom_counts2
 
 
-@partial(jax.jit, static_argnames=("rounds",))
-def auction_assign(req_q, free_q, free_pods, mask, scores, rounds: int = 16):
-    """Auction rounds for contention-heavy batches.
+@partial(jax.jit, static_argnames=("strategy",))
+def multistart_greedy_assign(req_q, req_nz_q, free_q, free_pods, used_nz_q,
+                             alloc_q, mask, static_scores, fit_col_w,
+                             bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                             strategy: str, perms, gang_onehot,
+                             gang_required):
+    """K permuted greedy scans in parallel + gang all-or-nothing.
 
-    Every unassigned pod bids its best (score − price) node; each node accepts
-    bids greedily by bid value while capacity lasts (approximated one winner
-    per node per round — capacity is re-checked each round); losing bids raise
-    the node's price by the winner-vs-runner-up margin + ε. After `rounds`,
-    leftovers go through `greedy_assign` on the remaining capacity.
+    Sequential greedy in queue order is the oracle, but it strands capacity
+    under contention (e.g. nodes of 4 CPU with queue [3,3,2,2,2]: the two
+    3s split the nodes and every 2 is stranded; order [2,2,2,...] places
+    three pods). The whole batch is known up front, so run the SAME
+    sequential-equivalent scan under K pod orders at once — vmap over
+    permutations, each scan threading its own capacity — and keep the
+    order that places the most pods. perms[0] must be the identity and
+    wins ties, so uncontended batches stay bit-identical to the oracle.
+
+    Gangs (Coscheduling all-or-nothing, SURVEY §2.8's EP-analog row):
+    gang_onehot (P, G) marks members, gang_required (G,) the minMember
+    floor; a scan's partial gang placements are dropped before counting,
+    making under-quota gangs atomic failures inside the solver rather
+    than Permit-barrier churn.
+
+    perms: (K, P) int32 permutations of the pod axis.
+    Returns (P,) int32 chosen assignment (-1 = unassigned).
     """
-    p, n = mask.shape
-    iota_n = jnp.arange(n, dtype=jnp.int32)
-    eps = jnp.float32(1.0)
+    P = req_q.shape[0]
+    arange_p = jnp.arange(P, dtype=jnp.int32)
 
-    def round_body(state, _):
-        assign, prices, free_q, free_pods = state
-        unassigned = assign < 0
-        fits = mask & jnp.all(req_q[:, None, :] <= free_q[None, :, :], axis=-1) \
-            & (free_pods >= 1)[None, :]
-        value = jnp.where(fits & unassigned[:, None],
-                          scores - prices[None, :], NEG_INF)
-        best = jnp.argmax(value, axis=1).astype(jnp.int32)          # (P,)
-        best_v = jnp.max(value, axis=1)
-        # Runner-up value for the price increment.
-        value2 = value.at[jnp.arange(p), best].set(NEG_INF)
-        second_v = jnp.max(value2, axis=1)
-        bidding = unassigned & jnp.isfinite(best_v)
-        bid = jnp.where(jnp.isfinite(second_v), best_v - second_v, eps) + eps
-        # One winner per node per round: highest bid (ties → lowest pod idx).
-        bid_mat = jnp.where(
-            bidding[:, None] & (iota_n[None, :] == best[:, None]),
-            bid[:, None], NEG_INF)                                   # (P,N)
-        win_pod = jnp.argmax(bid_mat, axis=0).astype(jnp.int32)      # (N,)
-        has_bid = jnp.any(jnp.isfinite(bid_mat), axis=0)
-        won = has_bid[best] & (win_pod[best] == jnp.arange(p, dtype=jnp.int32)) \
-            & bidding
-        assign = jnp.where(won, best, assign)
-        hit_counts = jnp.zeros((n,), jnp.int32).at[best].add(won.astype(jnp.int32))
-        spent = jnp.zeros_like(free_q).at[best].add(
-            jnp.where(won[:, None], req_q, 0))
-        free_q = free_q - spent
-        free_pods = free_pods - hit_counts
-        prices = prices + jnp.where(has_bid, jnp.max(bid_mat, axis=0), 0.0)
-        return (assign, prices, free_q, free_pods), None
+    def one(perm):
+        a = greedy_assign_rescoring(
+            req_q[perm], req_nz_q[perm], free_q, free_pods, used_nz_q,
+            alloc_q, mask[perm], static_scores[perm], fit_col_w,
+            bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy)
+        inv = jnp.zeros_like(perm).at[perm].set(arange_p)
+        return a[inv]
 
-    init = (jnp.full((p,), -1, jnp.int32), jnp.zeros((n,), jnp.float32),
-            free_q, free_pods)
-    (assign, _, rem_q, rem_pods), _ = lax.scan(
-        round_body, init, None, length=rounds)
+    assigns = jax.vmap(one)(perms)                         # (K, P)
+    eff = jax.vmap(
+        lambda a: gang_filter(a, gang_onehot, gang_required))(assigns)
+    placed = eff >= 0
+    n_placed = jnp.sum(placed, axis=1).astype(jnp.float32)
+    # Tie-break on total placed request volume: at equal pod count the
+    # order that consumes MORE capacity strands less (strictly better
+    # fragmentation). Full ties → lowest k (identity = oracle).
+    sizes = jnp.sum(req_q, axis=1).astype(jnp.float32)     # (P,)
+    vol = jnp.sum(jnp.where(placed, sizes[None, :], 0.0), axis=1)
+    vol_norm = vol / jnp.maximum(jnp.max(vol), 1.0)
+    best = jnp.argmax(n_placed + 0.5 * vol_norm)
+    return eff[best]
 
-    # Cleanup: remaining pods via the sequential-equivalent path.
-    leftover_mask = mask & (assign < 0)[:, None]
-    cleanup = greedy_assign(req_q, rem_q, rem_pods, leftover_mask, scores)
-    return jnp.where(assign < 0, cleanup, assign)
+
+def gang_filter(assign, gang_onehot, gang_required):
+    """Drop placements of gangs below their required member count."""
+    placed = (assign >= 0).astype(jnp.float32)
+    counts = placed @ gang_onehot                          # (G,)
+    gang_ok = (counts >= gang_required).astype(jnp.float32)
+    pod_in_gang = jnp.sum(gang_onehot, axis=1) > 0
+    pod_ok = (gang_onehot @ gang_ok) > 0
+    keep = (assign >= 0) & (pod_ok | ~pod_in_gang)
+    return jnp.where(keep, assign, -1)
 
 
 @jax.jit
