@@ -102,7 +102,13 @@ class LeaderElector:
                 elif time.time() - last_renew > self.renew_deadline:
                     payload.cancel()
                     break
-            await asyncio.gather(payload, return_exceptions=True)
+            res = (await asyncio.gather(payload, return_exceptions=True))[0]
+            # A crashed payload must surface, not read as a clean lease
+            # handover — silently absorbing it leaves a replica "running"
+            # that schedules nothing.
+            if isinstance(res, BaseException) and \
+                    not isinstance(res, asyncio.CancelledError):
+                raise res
         finally:
             self.is_leader = False
             if on_stopped_leading:

@@ -292,5 +292,17 @@ def build_scheduler(store, config=None, *, feature_gates: FeatureGate | None = N
             backend = TPUBackend()
         sched.backend = backend
         sched.backend_profiles = backend_profiles
+    if cfg.leader_elect:
+        # leaderElection.leaderElect: true → the caller runs the scheduler
+        # via sched.run_with_leader_election(sched.leader_elector).
+        import uuid
+
+        from kubernetes_tpu.client.leaderelection import LeaderElector
+        sched.leader_elector = LeaderElector(
+            store, cfg.leader_lock_name,
+            identity=f"scheduler-{uuid.uuid4().hex[:8]}",
+            lease_duration=cfg.leader_lease_duration,
+            renew_deadline=cfg.leader_renew_deadline,
+            retry_period=cfg.leader_retry_period)
     sched.config = cfg
     return sched

@@ -142,3 +142,17 @@ class ControllerManager:
         for c in self.controllers:
             await c.stop()
         self.factory.stop()
+
+    async def run_with_leader_election(self, elector) -> None:
+        """Leader-elected controller-manager lifetime: controllers run only
+        while holding the lease (kube-controller-manager's
+        leaderElectAndRun); losing it stops every controller so the
+        standby replica converges instead of fighting."""
+        async def lead():
+            await self.start()
+            await asyncio.Event().wait()  # run until cancelled
+
+        try:
+            await elector.run(on_started_leading=lead)
+        finally:
+            await self.stop()

@@ -399,16 +399,48 @@ class Scheduler:
             snapshot = self.cache.update_snapshot()
 
     async def _schedule_via_backend(self, pods: list[PodInfo], snapshot) -> None:
-        """Batched path: the backend returns {pod_key: node_name | None}."""
+        """Batched path: the backend returns {pod_key: node_name | None}.
+
+        Device failure is a first-class fault domain (SURVEY §5.3 "TPU
+        device loss → fall back to CPU path"): a backend crash falls this
+        batch back to the host path, and repeated crashes open a circuit
+        that disables the backend for the rest of the run."""
+        if self.backend is None:
+            # Circuit opened mid-batch by an earlier profile group.
+            for pi in pods:
+                await self._schedule_host_path(pi, snapshot)
+                snapshot = self.cache.update_snapshot()
+            return
         fwk = self.profiles.get(pods[0].scheduler_name) or next(iter(self.profiles.values()))
         t0 = time.perf_counter()
-        if hasattr(self.backend, "assign_async"):
-            # Pipelined path: device fetches run in a worker thread, so
-            # binding tasks keep draining during device/relay waits.
-            assignments, diagnostics = await self.backend.assign_async(
-                pods, snapshot, fwk)
-        else:
-            assignments, diagnostics = self.backend.assign(pods, snapshot, fwk)
+        try:
+            if hasattr(self.backend, "assign_async"):
+                # Pipelined path: device fetches run in a worker thread, so
+                # binding tasks keep draining during device/relay waits.
+                assignments, diagnostics = await self.backend.assign_async(
+                    pods, snapshot, fwk)
+            else:
+                assignments, diagnostics = self.backend.assign(
+                    pods, snapshot, fwk)
+            self._backend_failures = 0
+        except Exception:
+            self._backend_failures = getattr(
+                self, "_backend_failures", 0) + 1
+            logger.exception(
+                "TPU backend failed (%d consecutive); falling back to the "
+                "host path for this batch", self._backend_failures)
+            self.metrics.schedule_attempts.inc(
+                result="backend_fallback", profile=fwk.profile_name)
+            if self._backend_failures >= 3:
+                logger.error(
+                    "TPU backend circuit OPEN after %d consecutive "
+                    "failures — host path only from here",
+                    self._backend_failures)
+                self.backend = None
+            for pi in pods:
+                await self._schedule_host_path(pi, snapshot)
+                snapshot = self.cache.update_snapshot()
+            return
         elapsed = time.perf_counter() - t0
         for pi in pods:
             node = assignments.get(pi.key)
@@ -635,6 +667,25 @@ class Scheduler:
         finally:
             flusher.cancel()
             janitor.cancel()
+
+    async def run_with_leader_election(self, elector,
+                                       batch_size: int = 1) -> None:
+        """Leader-elected run (cmd/kube-scheduler app/server.go `Run`):
+        schedule only while holding the lease. Losing it stops the loop
+        AND awaits stop() — which cancels in-flight binding tasks — before
+        returning (fencing: a deposed leader must not write stale binds
+        while the standby schedules the same pods)."""
+        async def lead():
+            await self.run(batch_size=batch_size)
+
+        def lost():
+            self._stop = True
+
+        try:
+            await elector.run(on_started_leading=lead,
+                              on_stopped_leading=lost)
+        finally:
+            await self.stop()
 
     async def stop(self) -> None:
         self._stop = True

@@ -1,0 +1,183 @@
+"""Hypothesis state-machine tests for the scheduling queue and cache
+invariants (SURVEY §5.2: the discipline Go's race detector + mutexes
+enforced structurally — here the GIL hides data races but not logical
+ones, so the tiers/assume-expire state machines are property-tested)."""
+
+import asyncio
+
+from hypothesis import settings
+from hypothesis import strategies as st
+from hypothesis.stateful import (
+    RuleBasedStateMachine,
+    invariant,
+    rule,
+)
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.framework import Framework
+from kubernetes_tpu.scheduler.queue import ClusterEvent, SchedulingQueue
+from kubernetes_tpu.scheduler.types import PodInfo
+
+POD_NAMES = [f"pod-{i}" for i in range(8)]
+NODE_NAMES = [f"node-{i}" for i in range(4)]
+
+
+def _pi(name, priority=0):
+    return PodInfo(make_pod(name, priority=priority, uid=f"uid-{name}",
+                            requests={"cpu": "100m"}))
+
+
+class QueueMachine(RuleBasedStateMachine):
+    """activeQ / backoffQ / unschedulable / gated / in-flight tier
+    invariants: a pod key lives in AT MOST one tier; pop moves
+    active → in-flight; done/delete clear everywhere; move_all never
+    loses pods."""
+
+    def __init__(self):
+        super().__init__()
+        self.loop = asyncio.new_event_loop()
+        fwk = Framework([], {})
+        self.q = SchedulingQueue(fwk)
+        self.known: set[str] = set()
+
+    def go(self, coro):
+        return self.loop.run_until_complete(coro)
+
+    def teardown(self):
+        self.loop.close()
+
+    @rule(name=st.sampled_from(POD_NAMES),
+          priority=st.integers(min_value=0, max_value=100))
+    def add(self, name, priority):
+        self.go(self.q.add(_pi(name, priority)))
+        self.known.add(f"default/{name}")
+
+    @rule()
+    def pop_one(self):
+        async def body():
+            stats = self.q.stats()
+            if stats["active"] == 0:
+                return []
+            return await self.q.pop_batch(1)
+        pods = self.go(body())
+        for pi in pods:
+            # popped pods are in-flight, owned by "the cycle": requeue
+            # unschedulable or ack done — model a failed cycle here.
+            self.go(self.q.add_unschedulable(pi))
+
+    @rule(name=st.sampled_from(POD_NAMES))
+    def ack_done(self, name):
+        self.go(self.q.done(f"default/{name}"))
+
+    @rule(name=st.sampled_from(POD_NAMES))
+    def delete(self, name):
+        self.go(self.q.delete(f"default/{name}"))
+        self.known.discard(f"default/{name}")
+
+    @rule()
+    def cluster_event(self):
+        self.go(self.q.move_all(ClusterEvent("Node", "Add")))
+
+    @rule()
+    def flush(self):
+        self.go(self.q.flush_unschedulable_leftover())
+
+    @invariant()
+    def tiers_disjoint_and_complete(self):
+        q = self.q
+        tiers = {
+            "active": set(q._active_keys),
+            "backoff": set(q._backoff_keys),
+            "unsched": set(q._unschedulable),
+            "gated": set(q._gated),
+        }
+        names = list(tiers)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                overlap = tiers[a] & tiers[b]
+                assert not overlap, f"{a} ∩ {b} = {overlap}"
+        # stats() agrees with the internal sets.
+        st_ = q.stats()
+        assert st_["active"] == len(tiers["active"])
+        assert st_["backoff"] == len(tiers["backoff"])
+        assert st_["unschedulable"] == len(tiers["unsched"])
+
+
+class CacheMachine(RuleBasedStateMachine):
+    """assume/confirm/expire + snapshot: assumed pods appear on their node
+    exactly once; forget removes them; snapshot generation is monotonic
+    and node pod-counts match the model."""
+
+    def __init__(self):
+        super().__init__()
+        self.cache = SchedulerCache(assumed_pod_ttl=1e9)
+        self.now = 0.0
+        for n in NODE_NAMES:
+            self.cache.add_node(make_node(n))
+        #: model: pod key -> node name
+        self.placed: dict[str, str] = {}
+        self.last_generation = -1
+
+    @rule(name=st.sampled_from(POD_NAMES),
+          node=st.sampled_from(NODE_NAMES))
+    def assume(self, name, node):
+        key = f"default/{name}"
+        if key in self.placed:
+            return
+        self.cache.assume_pod(_pi(name), node)
+        self.placed[key] = node
+
+    @rule(name=st.sampled_from(POD_NAMES))
+    def finish_binding(self, name):
+        key = f"default/{name}"
+        if key in self.placed:
+            self.cache.finish_binding(key, now=self.now)
+
+    @rule(name=st.sampled_from(POD_NAMES))
+    def forget(self, name):
+        key = f"default/{name}"
+        if key in self.placed and self.cache.is_assumed(key):
+            self.cache.forget_pod(key)
+            del self.placed[key]
+
+    @rule(name=st.sampled_from(POD_NAMES),
+          node=st.sampled_from(NODE_NAMES))
+    def confirm_via_watch(self, name, node):
+        """The bound pod arrives via the informer (add_pod confirms an
+        assumed pod on the SAME node; a different node corrects it)."""
+        key = f"default/{name}"
+        if key not in self.placed:
+            return
+        pi = PodInfo(make_pod(name, node_name=self.placed[key],
+                              uid=f"uid-{name}",
+                              requests={"cpu": "100m"}))
+        self.cache.add_pod(pi)
+
+    @rule(name=st.sampled_from(POD_NAMES))
+    def remove(self, name):
+        key = f"default/{name}"
+        if key in self.placed and not self.cache.is_assumed(key):
+            self.cache.remove_pod(key)
+            del self.placed[key]
+
+    @invariant()
+    def snapshot_matches_model(self):
+        snap = self.cache.update_snapshot()
+        assert snap.generation >= self.last_generation
+        self.last_generation = snap.generation
+        seen: dict[str, str] = {}
+        for ni in snap:
+            for pi in ni.pods:
+                assert pi.key not in seen, \
+                    f"{pi.key} on both {seen[pi.key]} and {ni.name}"
+                seen[pi.key] = ni.name
+        assert seen == self.placed
+
+
+TestQueueProperties = QueueMachine.TestCase
+TestQueueProperties.settings = settings(
+    max_examples=40, stateful_step_count=30, deadline=None)
+TestCacheProperties = CacheMachine.TestCase
+TestCacheProperties.settings = settings(
+    max_examples=40, stateful_step_count=30, deadline=None)

