@@ -209,6 +209,11 @@ def split_node_topology(node_name: str, allocatable: Mapping[str, str],
     return make_node_resource_topology(node_name, zones)
 
 
+def make_namespace(name: str) -> dict:
+    """core/v1 Namespace (deletion fans out via NamespaceController)."""
+    return new_object("Namespace", name, None, status={"phase": "Active"})
+
+
 def make_binding(pod: Mapping, node_name: str) -> dict:
     """core/v1 Binding: target node for a pod; POSTed to the pod's /binding
     subresource (pkg/registry/core/pod/storage `BindingREST.Create`)."""

@@ -110,6 +110,14 @@ class LeaderElector:
                     not isinstance(res, asyncio.CancelledError):
                 raise res
         finally:
+            # run() itself cancelled (or renewal raised): the payload must
+            # not keep doing leader work without the lease.
+            if not payload.done():
+                payload.cancel()
+                try:
+                    await asyncio.gather(payload, return_exceptions=True)
+                except asyncio.CancelledError:
+                    pass
             self.is_leader = False
             if on_stopped_leading:
                 on_stopped_leading()

@@ -9,6 +9,10 @@ from kubernetes_tpu.controllers.deployment import (
     DeploymentController,
     make_deployment,
 )
+from kubernetes_tpu.controllers.garbagecollector import (
+    GarbageCollectorController,
+    NamespaceController,
+)
 from kubernetes_tpu.controllers.job import JobController, make_job
 from kubernetes_tpu.controllers.kwok import KwokController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
@@ -24,6 +28,8 @@ from kubernetes_tpu.controllers.statefulset import (
 )
 
 __all__ = [
+    "GarbageCollectorController",
+    "NamespaceController",
     "Controller", "ControllerManager",
     "DaemonSetController", "make_daemonset",
     "DeploymentController", "make_deployment",

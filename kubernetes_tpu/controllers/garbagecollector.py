@@ -1,0 +1,227 @@
+"""Garbage collector + namespace lifecycle controllers.
+
+Parity targets:
+- pkg/controller/garbagecollector/ (`GarbageCollector`, `GraphBuilder`):
+  an ownerReference dependency graph over watched resources; deleting an
+  owner cascades (background policy) to its dependents, and dependents
+  whose owner never existed / already vanished are collected on sight.
+- pkg/controller/namespace/ (`NamespaceController`): deleting a Namespace
+  fans out to every namespaced object inside it.
+
+Divergences, by design: the reference resolves the watchable set from
+API-server discovery and honors foreground-deletion finalizers; this
+store has a fixed resource list (`GC_RESOURCES`, extendable) and hard
+deletes, so cascade is always the background policy. `orphan` semantics
+(ownerReference removal instead of deletion) are honored when a
+dependent carries the `kubernetes.io/orphan` finalizer-equivalent
+annotation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from kubernetes_tpu.api.meta import (
+    name_of,
+    namespaced_name,
+    owner_references_of,
+    uid_of,
+)
+from kubernetes_tpu.client import InformerFactory, ResourceEventHandler
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.store.mvcc import StoreError
+
+logger = logging.getLogger(__name__)
+
+#: Resources participating in the ownerReference graph (both as owners and
+#: dependents). Order matters only for readability.
+GC_RESOURCES = [
+    "pods",
+    "replicasets",
+    "deployments",
+    "jobs",
+    "statefulsets",
+    "daemonsets",
+    "podgroups",
+    "persistentvolumeclaims",
+]
+
+#: Namespaced resources purged on namespace deletion.
+NAMESPACED_RESOURCES = GC_RESOURCES + ["events", "leases"]
+
+#: ownerReference kind → resource. Owners of kinds OUTSIDE this map are
+#: never treated as collectable (a Node-owned mirror pod or a custom
+#: resource's dependent must not be GC'd just because we don't watch the
+#: owner).
+KIND_TO_RESOURCE = {
+    "Pod": "pods",
+    "ReplicaSet": "replicasets",
+    "Deployment": "deployments",
+    "Job": "jobs",
+    "StatefulSet": "statefulsets",
+    "DaemonSet": "daemonsets",
+    "PodGroup": "podgroups",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+}
+
+
+class GarbageCollectorController(Controller):
+    """ownerReference graph → cascade deletion (background policy)."""
+
+    NAME = "garbage-collector"
+    WORKERS = 2
+    RESYNC_PERIOD = 5.0
+
+    def __init__(self, store, resources: list[str] | None = None):
+        super().__init__(store)
+        self.resources = list(resources or GC_RESOURCES)
+        #: live owner uids (from watched resources).
+        self._alive: set[str] = set()
+        #: owner uid -> {(resource, dependent key)}.
+        self._dependents: dict[str, set[tuple[str, str]]] = {}
+        #: dependent (resource, key) -> set of owner uids it waits on.
+        self._owners_of: dict[tuple[str, str], set[str]] = {}
+
+    def setup(self, factory: InformerFactory) -> None:
+        self._informers = {}
+        for resource in self.resources:
+            inf = factory.informer(resource)
+            self._informers[resource] = inf
+
+            def on_add(obj, resource=resource):
+                self._track(resource, obj)
+
+            def on_update(old, new, resource=resource):
+                self._track(resource, new)
+
+            def on_delete(obj, resource=resource):
+                self._on_delete(resource, obj)
+
+            inf.add_event_handler(ResourceEventHandler(
+                on_add=on_add, on_update=on_update, on_delete=on_delete))
+
+    def _track(self, resource: str, obj: dict) -> None:
+        uid = uid_of(obj)
+        if uid:
+            self._alive.add(uid)
+        dep = (resource, namespaced_name(obj))
+        refs = owner_references_of(obj)
+        old_owners = self._owners_of.pop(dep, set())
+        for ouid in old_owners:
+            self._dependents.get(ouid, set()).discard(dep)
+        if not refs:
+            return
+        owners = set()
+        for ref in refs:
+            ouid = ref.get("uid")
+            if not ouid:
+                continue
+            owners.add(ouid)
+            self._dependents.setdefault(ouid, set()).add(dep)
+        self._owners_of[dep] = owners
+        # Owner already gone (or never seen after sync) → collect now.
+        if owners and not any(o in self._alive for o in owners):
+            asyncio.ensure_future(self.queue.add(f"{resource}|{dep[1]}"))
+
+    def _on_delete(self, resource: str, obj: dict) -> None:
+        uid = uid_of(obj)
+        self._alive.discard(uid)
+        # The deleted object's OWN dependent bookkeeping must go too, or
+        # resync_keys re-enqueues its dead key forever and the maps leak.
+        dep = (resource, namespaced_name(obj))
+        for ouid in self._owners_of.pop(dep, set()):
+            self._dependents.get(ouid, set()).discard(dep)
+        for d in self._dependents.pop(uid, set()):
+            asyncio.ensure_future(self.queue.add(f"{d[0]}|{d[1]}"))
+
+    async def resync_keys(self):
+        # Orphan sweep: dependents whose every owner uid is dead.
+        out = []
+        for (resource, key), owners in list(self._owners_of.items()):
+            if owners and not any(o in self._alive for o in owners):
+                out.append(f"{resource}|{key}")
+        return out
+
+    async def sync(self, key: str) -> None:
+        resource, _, obj_key = key.partition("|")
+        inf = self._informers.get(resource)
+        obj = inf.indexer.get(obj_key) if inf is not None else None
+        if obj is None:
+            return
+        refs = owner_references_of(obj)
+        if not refs:
+            return
+        if any(ref.get("uid") in self._alive for ref in refs):
+            return  # an owner still exists (fast path)
+        # Authoritative verify against the store (the reference GC checks
+        # the API before cascading): the in-memory graph can lag its own
+        # informers, and unwatched owner kinds are NEVER collectable.
+        ns = obj.get("metadata", {}).get("namespace", "default")
+        for ref in refs:
+            owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
+            if owner_res is None:
+                return  # owner kind unwatched → leave the dependent alone
+            try:
+                owner = await self.store.get(
+                    owner_res, f"{ns}/{ref.get('name')}")
+            except StoreError:
+                continue  # this owner really is gone
+            if not ref.get("uid") or uid_of(owner) == ref.get("uid"):
+                return  # owner alive (uid matches) → keep dependent
+        anns = obj.get("metadata", {}).get("annotations") or {}
+        if anns.get("kubernetes.io/orphan") == "true":
+            # Orphan policy: strip ownerReferences, keep the object.
+            def strip(o):
+                o["metadata"].pop("ownerReferences", None)
+                return o
+            try:
+                await self.store.guaranteed_update(
+                    resource, obj_key, strip, return_copy=False)
+            except StoreError:
+                pass
+            return
+        logger.info("GC: cascading delete %s/%s (owners gone)",
+                    resource, obj_key)
+        try:
+            await self.store.delete(resource, obj_key, uid=uid_of(obj))
+        except StoreError:
+            pass
+
+
+class NamespaceController(Controller):
+    """Namespace deletion fan-out: purge every namespaced object in a
+    deleted namespace (namespace/namespace_controller.go `syncNamespace`
+    deletion path, minus finalizer staging — deletes here are hard)."""
+
+    NAME = "namespace"
+    WORKERS = 1
+
+    def __init__(self, store, resources: list[str] | None = None):
+        super().__init__(store)
+        self.resources = list(resources or NAMESPACED_RESOURCES)
+
+    def setup(self, factory: InformerFactory) -> None:
+        self._ns_informer = factory.informer("namespaces")
+
+        def on_delete(obj):
+            asyncio.ensure_future(self.queue.add(name_of(obj)))
+
+        self._ns_informer.add_event_handler(ResourceEventHandler(
+            on_delete=on_delete))
+
+    async def sync(self, key: str) -> None:
+        # Namespace gone → delete everything inside it.
+        for resource in self.resources:
+            try:
+                items = (await self.store.list(resource)).items
+            except StoreError:
+                continue
+            for obj in items:
+                if obj.get("metadata", {}).get("namespace") != key:
+                    continue
+                try:
+                    await self.store.delete(
+                        resource, namespaced_name(obj), uid=uid_of(obj))
+                except StoreError:
+                    pass

@@ -144,15 +144,19 @@ class CacheMachine(RuleBasedStateMachine):
     @rule(name=st.sampled_from(POD_NAMES),
           node=st.sampled_from(NODE_NAMES))
     def confirm_via_watch(self, name, node):
-        """The bound pod arrives via the informer (add_pod confirms an
-        assumed pod on the SAME node; a different node corrects it)."""
+        """The bound pod arrives via the informer: add_pod confirms an
+        assumed pod on the SAME node, and a DIFFERENT watched node
+        corrects the optimistic assume (the API is the truth). Once
+        CONFIRMED, nodeName is immutable — the API can never report a
+        bound pod moving, so the drawn node only applies while assumed."""
         key = f"default/{name}"
         if key not in self.placed:
             return
-        pi = PodInfo(make_pod(name, node_name=self.placed[key],
-                              uid=f"uid-{name}",
+        target = node if self.cache.is_assumed(key) else self.placed[key]
+        pi = PodInfo(make_pod(name, node_name=target, uid=f"uid-{name}",
                               requests={"cpu": "100m"}))
         self.cache.add_pod(pi)
+        self.placed[key] = target
 
     @rule(name=st.sampled_from(POD_NAMES))
     def remove(self, name):
