@@ -46,6 +46,10 @@ def main(argv=None) -> int:
                     help="cross the process boundary: workload writes, "
                          "informers, and binding POSTs go over the HTTP "
                          "apiserver (reference scheduler_perf topology)")
+    ap.add_argument("--profile-dir", default="",
+                    help="write a jax.profiler device trace of the "
+                         "MEASURED phase to this directory (tpu backend "
+                         "only)")
     ap.add_argument("--feature-gates", default="",
                     help='e.g. "TPUScorer=true" — the north-star seam: the '
                          "batched device backend hangs off this gate "
@@ -90,8 +94,12 @@ def main(argv=None) -> int:
     # threshold trades peak RSS for wall, like tuning GOGC on the reference.
     gc.set_threshold(100_000, 50, 50)
 
+    if args.profile_dir and backend is None:
+        print("warning: --profile-dir needs --backend tpu; no trace "
+              "will be written", file=sys.stderr)
     runner = PerfRunner(backend=backend, batch_size=batch,
-                        through_apiserver=args.through_apiserver)
+                        through_apiserver=args.through_apiserver,
+                        profile_dir=args.profile_dir or None)
     res = asyncio.run(runner.run(template, params, timeout=1800.0))
 
     detail = res.as_dict()

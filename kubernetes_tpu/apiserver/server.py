@@ -155,6 +155,7 @@ class APIServer:
                  host: str = "127.0.0.1", port: int = 0,
                  priority_levels: Mapping[str, PriorityLevel] | None = None,
                  bearer_tokens: Mapping[str, str] | None = None,
+                 authorizer=None,
                  metrics_registry=None,
                  audit_log: bool = False):
         self.store = store
@@ -167,6 +168,9 @@ class APIServer:
             "workload": PriorityLevel("workload", seats=32),
         })
         self.bearer_tokens = dict(bearer_tokens or {})  # token -> username
+        #: RBACAuthorizer (apiserver/rbac.py) or None = authz disabled
+        #: (the reference's AlwaysAllow mode).
+        self.authorizer = authorizer
         self.metrics_registry = metrics_registry
         self.audit_log = audit_log
         self._runner: web.AppRunner | None = None
@@ -180,7 +184,8 @@ class APIServer:
             self._mw_request_info,    # WithRequestInfo
             self._mw_authn,           # WithAuthentication
             self._mw_priority,        # WithPriorityAndFairness
-            self._mw_audit,           # WithAudit
+            self._mw_audit,           # WithAudit (records authz denials)
+            self._mw_authz,           # WithAuthorization (RBAC, innermost)
         ])
         app.router.add_get("/healthz", self._healthz)
         app.router.add_get("/readyz", self._healthz)
@@ -254,6 +259,21 @@ class APIServer:
                         status=401)
                 user = "system:anonymous"
         request["user"] = user
+        return await handler(request)
+
+    @web.middleware
+    async def _mw_authz(self, request: web.Request, handler):
+        if self.authorizer is None or \
+                request.path in ("/healthz", "/readyz", "/metrics"):
+            return await handler(request)
+        user = request.get("user", "system:anonymous")
+        verb = request.get("verb", "")
+        resource = request.get("resource", "")
+        if not self.authorizer.allowed(user, verb, resource):
+            return web.json_response(_status_body(
+                403, "Forbidden",
+                f'user "{user}" cannot {verb} resource "{resource}"'),
+                status=403)
         return await handler(request)
 
     def _classify(self, request: web.Request) -> PriorityLevel:

@@ -459,6 +459,29 @@ class TPUBackend:
             hit = self._row_cache[key] = (row, bool(row.any()))
         return hit
 
+    # -- profiling (SURVEY §5.1: jax.profiler hook) -----------------------
+
+    def start_profile(self, log_dir: str) -> bool:
+        """Begin a device trace (TensorBoard/Perfetto readable). Returns
+        False when the platform's profiler is unavailable (the axon relay
+        may not support it) rather than failing the run."""
+        try:
+            jax.profiler.start_trace(log_dir)
+            self._profiling = True
+            return True
+        except Exception as e:  # pragma: no cover - platform dependent
+            logger.warning("jax profiler unavailable: %s", e)
+            self._profiling = False
+            return False
+
+    def stop_profile(self) -> None:
+        if getattr(self, "_profiling", False):
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover
+                logger.warning("jax profiler stop failed: %s", e)
+            self._profiling = False
+
     def _gang_args(self, prep: dict, batch) -> tuple:
         """(gang_onehot, gang_required) device arrays; the no-gang case
         reuses one cached zero pair per batch width."""

@@ -103,7 +103,8 @@ class PerfRunner:
     def __init__(self, backend=None, batch_size: int = 1,
                  scheduler_kwargs: Mapping | None = None,
                  scheduler_config: Mapping | None = None,
-                 through_apiserver: bool = False):
+                 through_apiserver: bool = False,
+                 profile_dir: str | None = None):
         self.backend = backend
         self.batch_size = batch_size
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
@@ -115,6 +116,9 @@ class PerfRunner:
         #: writes, the scheduler's informers, and binding POSTs — goes over
         #: the HTTP apiserver instead of direct store calls.
         self.through_apiserver = through_apiserver
+        #: jax.profiler trace of the MEASURED phase only (not warmup/jit
+        #: compile) when the backend supports it.
+        self.profile_dir = profile_dir
 
     async def run(self, template_ops: list, params: Mapping[str, Any],
                   timeout: float = 600.0) -> WorkloadResult:
@@ -210,6 +214,9 @@ class PerfRunner:
                         # throughput cover only the measured phase (warmup
                         # attempts — including jit compile — are excluded).
                         window = self._begin_measure(metrics)
+                        if self.profile_dir and hasattr(
+                                self.backend, "start_profile"):
+                            self.backend.start_profile(self.profile_dir)
                     names = [f"pod-{pod_seq + i}" for i in range(count)]
                     # Writes go out in concurrent windows (the reference
                     # harness drives the apiserver with multi-goroutine
@@ -230,6 +237,9 @@ class PerfRunner:
                         want = {f"{pod_ns}/{n}" for n in names}
                         await self._wait_keys(bound_keys, want, deadline)
                         self._end_measure(result, metrics, window, count)
+                        if self.profile_dir and hasattr(
+                                self.backend, "stop_profile"):
+                            self.backend.stop_profile()
 
                 elif opcode == "ungatePods":
                     # Strip schedulingGates from every gated pod (the

@@ -44,6 +44,7 @@ from kubernetes_tpu.scheduler.plugins.registry import (
 )
 from kubernetes_tpu.scheduler.queue import ClusterEvent, SchedulingQueue
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+from kubernetes_tpu.utils.trace import Trace
 
 logger = logging.getLogger(__name__)
 
@@ -83,6 +84,7 @@ class Scheduler:
         backend=None,
         pod_initial_backoff: float = 1.0,
         pod_max_backoff: float = 10.0,
+        trace_threshold_ms: float = 100.0,
     ):
         self.store = store
         self.metrics = metrics or SchedulerMetrics()
@@ -110,6 +112,9 @@ class Scheduler:
             default_fwk, initial_backoff=pod_initial_backoff,
             max_backoff=pod_max_backoff)
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        #: utiltrace threshold: scheduling attempts slower than this log a
+        #: step-by-step latency trace (SURVEY §5.1).
+        self.trace_threshold_ms = trace_threshold_ms
         self.rng = random.Random(seed)
         self.backend = backend  # TPU batch backend; None = host path
         #: Profiles the batched backend serves (TPUScorer gate, per-profile);
@@ -367,7 +372,14 @@ class Scheduler:
         return True
 
     async def _schedule_pods(self, pods: list[PodInfo]) -> None:
+        with Trace("Scheduling", threshold_ms=self.trace_threshold_ms,
+                   pods=len(pods)) as tr:
+            await self._schedule_pods_traced(pods, tr)
+
+    async def _schedule_pods_traced(self, pods: list[PodInfo],
+                                    tr) -> None:
         snapshot = self.cache.update_snapshot()
+        tr.step("snapshot")
         # Extenders are per-pod HTTP webhooks whose round-trips dominate any
         # batch win, and their filter verdicts must precede assignment — so
         # configured extenders route pods through the (extender-aware) host
@@ -387,16 +399,19 @@ class Scheduler:
                 if self.backend_profiles is None or \
                         sname in self.backend_profiles:
                     await self._schedule_via_backend(group, snapshot)
+                    tr.step(f"backend assign [{sname}] ({len(group)} pods)")
                     snapshot = self.cache.update_snapshot()
                 else:
                     for pi in group:
                         await self._schedule_host_path(pi, snapshot)
                         snapshot = self.cache.update_snapshot()
+                    tr.step(f"host path [{sname}] ({len(group)} pods)")
             return
         for pi in pods:
             await self._schedule_host_path(pi, snapshot)
             # Re-snapshot so pods later in the batch see earlier assumes.
             snapshot = self.cache.update_snapshot()
+        tr.step(f"host path ({len(pods)} pods)")
 
     async def _schedule_via_backend(self, pods: list[PodInfo], snapshot) -> None:
         """Batched path: the backend returns {pod_key: node_name | None}.
