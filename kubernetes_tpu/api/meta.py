@@ -128,3 +128,24 @@ def deep_copy(obj: Any) -> Any:
     if isinstance(obj, list):
         return [deep_copy(v) for v in obj]
     return obj
+
+
+#: kind → store resource, the one shared mapping (CLI apply/delete, the
+#: garbage collector's owner lookup, and the API server all key off it).
+KIND_TO_RESOURCE = {
+    "Pod": "pods", "Node": "nodes", "Namespace": "namespaces",
+    "Deployment": "deployments", "ReplicaSet": "replicasets",
+    "StatefulSet": "statefulsets", "DaemonSet": "daemonsets",
+    "Job": "jobs", "PodGroup": "podgroups",
+    "PersistentVolume": "persistentvolumes",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "StorageClass": "storageclasses",
+    "NodeResourceTopology": "noderesourcetopologies",
+    "Service": "services", "Event": "events", "Lease": "leases",
+}
+
+#: resources without a namespace segment in their keys/URLs.
+CLUSTER_SCOPED_RESOURCES = {
+    "nodes", "namespaces", "persistentvolumes", "storageclasses",
+    "noderesourcetopologies",
+}

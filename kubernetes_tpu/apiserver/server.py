@@ -40,8 +40,7 @@ from kubernetes_tpu.store.mvcc import (
 
 logger = logging.getLogger(__name__)
 
-#: Resources without a namespace segment (everything else is namespaced).
-CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses"}
+from kubernetes_tpu.api.meta import CLUSTER_SCOPED_RESOURCES as CLUSTER_SCOPED
 
 
 def _status_body(code: int, reason: str, message: str) -> dict:

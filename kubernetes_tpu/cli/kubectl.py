@@ -1,0 +1,428 @@
+"""ktpuctl: the kubectl-equivalent CLI (SURVEY §2.7).
+
+Parity target: staging/src/k8s.io/kubectl `pkg/cmd/` — the operational
+verbs an operator needs against the API server: get, describe, apply,
+create, delete, scale, cordon/uncordon, drain, top. Talks HTTP to an
+APIServer (`--server`), or to an in-process store when a caller passes
+one (tests, embedded tools).
+
+    python -m kubernetes_tpu.cli get pods -n default
+    python -m kubernetes_tpu.cli apply -f manifest.yaml
+    python -m kubernetes_tpu.cli drain node-3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Any
+
+from kubernetes_tpu.api.meta import (
+    CLUSTER_SCOPED_RESOURCES as CLUSTER_SCOPED,
+    KIND_TO_RESOURCE,
+    namespaced_name,
+)
+from kubernetes_tpu.store.mvcc import NotFound, StoreError
+
+#: short names (kubectl's builtin aliases).
+ALIASES = {
+    "po": "pods", "no": "nodes", "ns": "namespaces",
+    "deploy": "deployments", "rs": "replicasets", "sts": "statefulsets",
+    "ds": "daemonsets", "pv": "persistentvolumes",
+    "pvc": "persistentvolumeclaims", "sc": "storageclasses", "ev": "events",
+}
+
+
+def _resource(arg: str) -> str:
+    return ALIASES.get(arg, arg)
+
+
+def _key(resource: str, name: str, namespace: str) -> str:
+    if resource in CLUSTER_SCOPED:
+        return name
+    return f"{namespace}/{name}"
+
+
+def _age(obj: dict) -> str:
+    ts = obj.get("metadata", {}).get("creationTimestamp")
+    if not ts:
+        return "<none>"
+    try:
+        import datetime
+        created = datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+        secs = max(0, time.time() - created.timestamp())
+    except ValueError:
+        return "<invalid>"
+    if secs < 120:
+        return f"{int(secs)}s"
+    if secs < 7200:
+        return f"{int(secs // 60)}m"
+    if secs < 172800:
+        return f"{int(secs // 3600)}h"
+    return f"{int(secs // 86400)}d"
+
+
+def _pod_row(p: dict) -> list[str]:
+    status = p.get("status", {}).get("phase", "Unknown")
+    if p.get("metadata", {}).get("deletionTimestamp"):
+        status = "Terminating"
+    return [p["metadata"]["name"], status,
+            p.get("spec", {}).get("nodeName") or "<none>", _age(p)]
+
+
+def _node_row(n: dict) -> list[str]:
+    ready = "Unknown"
+    for c in n.get("status", {}).get("conditions") or []:
+        if c.get("type") == "Ready":
+            ready = "Ready" if c.get("status") == "True" else "NotReady"
+    if n.get("spec", {}).get("unschedulable"):
+        ready += ",SchedulingDisabled"
+    return [n["metadata"]["name"], ready, _age(n)]
+
+
+def _print_table(headers: list[str], rows: list[list[str]], out) -> None:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)), file=out)
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)), file=out)
+
+
+def _dump(obj: Any, fmt: str, out) -> None:
+    if fmt == "json":
+        print(json.dumps(obj, indent=2), file=out)
+    else:
+        import yaml
+        print(yaml.safe_dump(obj, sort_keys=False).rstrip(), file=out)
+
+
+async def cmd_get(store, args, out) -> int:
+    resource = _resource(args.resource)
+    if args.name:
+        try:
+            obj = await store.get(resource,
+                                  _key(resource, args.name, args.namespace))
+        except NotFound as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        if args.output in ("yaml", "json"):
+            _dump(obj, args.output, out)
+            return 0
+        items = [obj]
+    else:
+        if args.selector:
+            from kubernetes_tpu.api.labels import parse_selector
+            lst = await store.list(
+                resource, selector=parse_selector(args.selector))
+        else:
+            lst = await store.list(resource)
+        items = lst.items
+        if resource not in CLUSTER_SCOPED and not args.all_namespaces:
+            items = [o for o in items
+                     if o.get("metadata", {}).get("namespace",
+                                                  "default") == args.namespace]
+        if args.output in ("yaml", "json"):
+            _dump({"kind": "List", "items": items}, args.output, out)
+            return 0
+    if resource == "pods":
+        _print_table(["NAME", "STATUS", "NODE", "AGE"],
+                     [_pod_row(o) for o in items], out)
+    elif resource == "nodes":
+        _print_table(["NAME", "STATUS", "AGE"],
+                     [_node_row(o) for o in items], out)
+    else:
+        _print_table(["NAME", "AGE"],
+                     [[o["metadata"]["name"], _age(o)] for o in items], out)
+    return 0
+
+
+async def cmd_describe(store, args, out) -> int:
+    resource = _resource(args.resource)
+    key = _key(resource, args.name, args.namespace)
+    try:
+        obj = await store.get(resource, key)
+    except NotFound as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    _dump(obj, "yaml", out)
+    # Trailing Events section (kubectl describe's most-used part).
+    try:
+        events = (await store.list("events")).items
+    except StoreError:
+        events = []
+    want_kind = {k for k, r in KIND_TO_RESOURCE.items() if r == resource}
+    related = []
+    for e in events:
+        inv = e.get("involvedObject") or {}
+        if inv.get("name") != args.name:
+            continue
+        if inv.get("kind") and want_kind and inv["kind"] not in want_kind:
+            continue
+        if resource not in CLUSTER_SCOPED and \
+                inv.get("namespace", args.namespace) != args.namespace:
+            continue
+        related.append(e)
+    if related:
+        print("\nEvents:", file=out)
+        for e in related[-10:]:
+            print(f"  {e.get('type', '')}\t{e.get('reason', '')}\t"
+                  f"{e.get('message', '')}", file=out)
+    return 0
+
+
+def _load_manifests(path: str) -> list[dict]:
+    import yaml
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+async def cmd_apply(store, args, out) -> int:
+    rc = 0
+    for obj in _load_manifests(args.filename):
+        resource = KIND_TO_RESOURCE.get(obj.get("kind", ""))
+        if resource is None:
+            print(f"Error: unknown kind {obj.get('kind')!r}", file=sys.stderr)
+            rc = 1
+            continue
+        meta = obj.setdefault("metadata", {})
+        if resource not in CLUSTER_SCOPED:
+            meta.setdefault("namespace", args.namespace)
+        key = _key(resource, meta.get("name", ""),
+                   meta.get("namespace", args.namespace))
+        try:
+            current = await store.get(resource, key)
+        except NotFound:
+            await store.create(resource, obj)
+            print(f"{resource}/{meta.get('name')} created", file=out)
+            continue
+        # apply = replace spec-ish fields, keep server-owned metadata.
+        merged = dict(current)
+        for k, v in obj.items():
+            if k != "metadata":
+                merged[k] = v
+        merged["metadata"] = dict(current["metadata"])
+        for k in ("labels", "annotations"):
+            if k in meta:
+                merged["metadata"][k] = meta[k]
+        await store.update(resource, merged)
+        print(f"{resource}/{meta.get('name')} configured", file=out)
+    return rc
+
+
+async def cmd_delete(store, args, out) -> int:
+    if args.filename:
+        rc = 0
+        for obj in _load_manifests(args.filename):
+            resource = KIND_TO_RESOURCE.get(obj.get("kind", ""))
+            if resource is None:
+                print(f"Error: unknown kind {obj.get('kind')!r}",
+                      file=sys.stderr)
+                rc = 1
+                continue
+            meta = obj.get("metadata", {})
+            key = _key(resource, meta.get("name", ""),
+                       meta.get("namespace", args.namespace))
+            try:
+                await store.delete(resource, key)
+                print(f"{resource}/{meta.get('name')} deleted", file=out)
+            except StoreError as e:
+                print(f"Error: {e}", file=sys.stderr)
+                rc = 1
+        return rc
+    resource = _resource(args.resource)
+    try:
+        await store.delete(resource,
+                           _key(resource, args.name, args.namespace))
+    except StoreError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"{resource}/{args.name} deleted", file=out)
+    return 0
+
+
+async def cmd_scale(store, args, out) -> int:
+    resource = _resource(args.resource)
+    key = _key(resource, args.name, args.namespace)
+
+    def mutate(obj):
+        if resource == "jobs":
+            obj.setdefault("spec", {})["parallelism"] = args.replicas
+        else:
+            obj.setdefault("spec", {})["replicas"] = args.replicas
+        return obj
+    try:
+        await store.guaranteed_update(resource, key, mutate,
+                                     return_copy=False)
+    except StoreError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"{resource}/{args.name} scaled to {args.replicas}", file=out)
+    return 0
+
+
+async def _set_unschedulable(store, node: str, value: bool) -> None:
+    def mutate(obj):
+        if value:
+            obj.setdefault("spec", {})["unschedulable"] = True
+        else:
+            obj.get("spec", {}).pop("unschedulable", None)
+        return obj
+    await store.guaranteed_update("nodes", node, mutate, return_copy=False)
+
+
+async def cmd_cordon(store, args, out) -> int:
+    await _set_unschedulable(store, args.node, True)
+    print(f"node/{args.node} cordoned", file=out)
+    return 0
+
+
+async def cmd_uncordon(store, args, out) -> int:
+    await _set_unschedulable(store, args.node, False)
+    print(f"node/{args.node} uncordoned", file=out)
+    return 0
+
+
+async def cmd_drain(store, args, out) -> int:
+    """cordon + evict: delete the node's pods except DaemonSet-owned
+    (kubectl drain --ignore-daemonsets semantics)."""
+    await _set_unschedulable(store, args.node, True)
+    pods = (await store.list("pods")).items
+    for p in pods:
+        if p.get("spec", {}).get("nodeName") != args.node:
+            continue
+        refs = p.get("metadata", {}).get("ownerReferences") or []
+        if any(r.get("kind") == "DaemonSet" for r in refs):
+            continue
+        try:
+            await store.delete("pods", namespaced_name(p))
+            print(f"pod/{p['metadata']['name']} evicted", file=out)
+        except StoreError as e:
+            print(f"Error evicting {p['metadata']['name']}: {e}",
+                  file=sys.stderr)
+    print(f"node/{args.node} drained", file=out)
+    return 0
+
+
+async def cmd_top(store, args, out) -> int:
+    """top nodes: requested/allocatable per node (the scheduler's view —
+    there is no metrics-server; requests are the capacity signal here)."""
+    from kubernetes_tpu.api.resource import format_quantity, parse_quantity
+    from kubernetes_tpu.api.types import pod_is_terminal, pod_requests
+    nodes = (await store.list("nodes")).items
+    pods = (await store.list("pods")).items
+    used: dict[str, dict[str, int]] = {}
+    for p in pods:
+        node = p.get("spec", {}).get("nodeName")
+        if not node or pod_is_terminal(p):
+            continue  # Succeeded/Failed pods hold no capacity
+        agg = used.setdefault(node, {})
+        for r, v in pod_requests(p).items():
+            agg[r] = agg.get(r, 0) + v
+    rows = []
+    for n in nodes:
+        name = n["metadata"]["name"]
+        alloc = n.get("status", {}).get("allocatable") or {}
+        cpu_a = parse_quantity(alloc.get("cpu", 0))
+        mem_a = parse_quantity(alloc.get("memory", 0))
+        cpu_u = used.get(name, {}).get("cpu", 0)
+        mem_u = used.get(name, {}).get("memory", 0)
+        rows.append([
+            name,
+            f"{format_quantity(cpu_u)}/{format_quantity(cpu_a)}",
+            f"{100 * cpu_u // cpu_a if cpu_a else 0}%",
+            f"{format_quantity(mem_u)}/{format_quantity(mem_a)}",
+            f"{100 * mem_u // mem_a if mem_a else 0}%",
+        ])
+    _print_table(["NAME", "CPU(req/alloc)", "CPU%",
+                  "MEM(req/alloc)", "MEM%"], rows, out)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="ktpuctl", description=__doc__)
+    ap.add_argument("--server", "-s", default="http://127.0.0.1:8080",
+                    help="API server URL")
+    ap.add_argument("--token", default=None, help="bearer token")
+    ap.add_argument("--namespace", "-n", default="default")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=["table", "yaml", "json"],
+                   default="table")
+    g.add_argument("-l", "--selector", default=None)
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.set_defaults(fn=cmd_get)
+
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name")
+    d.set_defaults(fn=cmd_describe)
+
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True)
+    a.set_defaults(fn=cmd_apply)
+
+    rm = sub.add_parser("delete")
+    rm.add_argument("resource", nargs="?")
+    rm.add_argument("name", nargs="?")
+    rm.add_argument("-f", "--filename", default=None)
+    rm.set_defaults(fn=cmd_delete)
+
+    sc = sub.add_parser("scale")
+    sc.add_argument("resource")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.set_defaults(fn=cmd_scale)
+
+    for verb, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon),
+                     ("drain", cmd_drain)):
+        c = sub.add_parser(verb)
+        c.add_argument("node")
+        c.set_defaults(fn=fn)
+
+    t = sub.add_parser("top")
+    t.add_argument("what", choices=["nodes"])
+    t.set_defaults(fn=cmd_top)
+    return ap
+
+
+async def run_command(store, args, out=None) -> int:
+    """Entry for tests/embedding: run one parsed command against any
+    MVCCStore-shaped object (RemoteStore or in-process)."""
+    return await args.fn(store, args, out or sys.stdout)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    async def body() -> int:
+        from kubernetes_tpu.apiserver.client import RemoteStore
+        rs = RemoteStore(args.server, token=args.token)
+        try:
+            return await run_command(rs, args)
+        finally:
+            await rs.close()
+
+    try:
+        return asyncio.run(body())
+    except StoreError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:  # file not found, connection refused, ...
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:  # bad selector / quantity / YAML scalar
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:  # aiohttp client errors etc. — one line, rc 1
+        print(f"Error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,20 +50,14 @@ GC_RESOURCES = [
 #: Namespaced resources purged on namespace deletion.
 NAMESPACED_RESOURCES = GC_RESOURCES + ["events", "leases"]
 
-#: ownerReference kind → resource. Owners of kinds OUTSIDE this map are
-#: never treated as collectable (a Node-owned mirror pod or a custom
-#: resource's dependent must not be GC'd just because we don't watch the
-#: owner).
-KIND_TO_RESOURCE = {
-    "Pod": "pods",
-    "ReplicaSet": "replicasets",
-    "Deployment": "deployments",
-    "Job": "jobs",
-    "StatefulSet": "statefulsets",
-    "DaemonSet": "daemonsets",
-    "PodGroup": "podgroups",
-    "PersistentVolumeClaim": "persistentvolumeclaims",
-}
+#: ownerReference kind → resource (shared mapping; see api/meta.py).
+#: Owners of kinds OUTSIDE the GC's WATCHED resources are never treated
+#: as collectable (a Node-owned mirror pod or a custom resource's
+#: dependent must not be GC'd just because we don't watch the owner).
+from kubernetes_tpu.api.meta import (  # noqa: E402
+    CLUSTER_SCOPED_RESOURCES,
+    KIND_TO_RESOURCE,
+)
 
 
 class GarbageCollectorController(Controller):
@@ -161,10 +155,12 @@ class GarbageCollectorController(Controller):
         for ref in refs:
             owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
             if owner_res is None:
-                return  # owner kind unwatched → leave the dependent alone
+                return  # owner kind unknown → leave the dependent alone
+            owner_key = ref.get("name") \
+                if owner_res in CLUSTER_SCOPED_RESOURCES \
+                else f"{ns}/{ref.get('name')}"
             try:
-                owner = await self.store.get(
-                    owner_res, f"{ns}/{ref.get('name')}")
+                owner = await self.store.get(owner_res, owner_key)
             except StoreError:
                 continue  # this owner really is gone
             if not ref.get("uid") or uid_of(owner) == ref.get("uid"):

@@ -1,0 +1,178 @@
+"""ktpuctl CLI (SURVEY §2.7): get/describe/apply/delete/scale/cordon/
+drain/top against the in-process store AND over the HTTP apiserver."""
+
+import asyncio
+import io
+
+import yaml
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.cli.kubectl import build_parser, run_command
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _cli(store, *argv):
+    args = build_parser().parse_args(list(argv))
+    out = io.StringIO()
+    rc = await run_command(store, args, out)
+    return rc, out.getvalue()
+
+
+async def seeded_store():
+    store = new_cluster_store()
+    install_core_validation(store)
+    for i in range(2):
+        await store.create("nodes", make_node(f"n{i}"))
+    await store.create("pods", make_pod(
+        "web-1", labels={"app": "web"}, node_name="n0",
+        requests={"cpu": "500m", "memory": "1Gi"}, phase="Running"))
+    await store.create("pods", make_pod("pending-1"))
+    return store
+
+
+class TestGetDescribe:
+    def test_get_pods_table(self):
+        async def body():
+            store = await seeded_store()
+            rc, out = await _cli(store, "get", "pods")
+            assert rc == 0
+            assert "web-1" in out and "Running" in out and "n0" in out
+            assert "pending-1" in out and "<none>" in out
+            store.stop()
+        run(body())
+
+    def test_get_with_selector_and_yaml(self):
+        async def body():
+            store = await seeded_store()
+            rc, out = await _cli(store, "get", "pods", "-l", "app=web",
+                                 "-o", "yaml")
+            assert rc == 0
+            docs = yaml.safe_load(out)
+            assert [i["metadata"]["name"] for i in docs["items"]] == ["web-1"]
+            store.stop()
+        run(body())
+
+    def test_get_nodes_and_aliases(self):
+        async def body():
+            store = await seeded_store()
+            rc, out = await _cli(store, "get", "no")
+            assert rc == 0 and "Ready" in out
+            store.stop()
+        run(body())
+
+    def test_describe_includes_events(self):
+        async def body():
+            store = await seeded_store()
+            await store.create("events", {
+                "kind": "Event", "metadata": {"name": "e1",
+                                              "namespace": "default"},
+                "involvedObject": {"kind": "Pod", "name": "web-1"},
+                "type": "Normal", "reason": "Scheduled",
+                "message": "assigned"})
+            rc, out = await _cli(store, "describe", "pods", "web-1")
+            assert rc == 0
+            assert "web-1" in out and "Scheduled" in out
+            store.stop()
+        run(body())
+
+
+class TestApplyScaleDelete:
+    def test_apply_create_then_configure(self, tmp_path):
+        async def body():
+            store = await seeded_store()
+            manifest = tmp_path / "m.yaml"
+            manifest.write_text(yaml.safe_dump_all([
+                {"apiVersion": "apps/v1", "kind": "Deployment",
+                 "metadata": {"name": "d"},
+                 "spec": {"replicas": 2,
+                          "selector": {"matchLabels": {"app": "d"}},
+                          "template": {
+                              "metadata": {"labels": {"app": "d"}},
+                              "spec": {"containers": [
+                                  {"name": "c", "image": "x:1"}]}}}}]))
+            rc, out = await _cli(store, "apply", "-f", str(manifest))
+            assert rc == 0 and "created" in out
+            # Mutate + re-apply → configured, replicas updated.
+            text = manifest.read_text().replace("replicas: 2", "replicas: 5")
+            manifest.write_text(text)
+            rc, out = await _cli(store, "apply", "-f", str(manifest))
+            assert rc == 0 and "configured" in out
+            d = await store.get("deployments", "default/d")
+            assert d["spec"]["replicas"] == 5
+            store.stop()
+        run(body())
+
+    def test_scale_and_delete(self):
+        async def body():
+            store = await seeded_store()
+            await store.create("deployments", {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": "d", "namespace": "default"},
+                "spec": {"replicas": 1}})
+            rc, _ = await _cli(store, "scale", "deploy", "d",
+                               "--replicas", "4")
+            assert rc == 0
+            d = await store.get("deployments", "default/d")
+            assert d["spec"]["replicas"] == 4
+            rc, out = await _cli(store, "delete", "deployments", "d")
+            assert rc == 0 and "deleted" in out
+            store.stop()
+        run(body())
+
+
+class TestNodeOps:
+    def test_cordon_drain_uncordon(self):
+        async def body():
+            store = await seeded_store()
+            ds_pod = make_pod("ds-pod", node_name="n0")
+            ds_pod["metadata"]["ownerReferences"] = [
+                {"kind": "DaemonSet", "name": "ds", "uid": "u1",
+                 "controller": True}]
+            await store.create("pods", ds_pod)
+            rc, out = await _cli(store, "drain", "n0")
+            assert rc == 0
+            node = await store.get("nodes", "n0")
+            assert node["spec"]["unschedulable"] is True
+            pods = {p["metadata"]["name"]
+                    for p in (await store.list("pods")).items}
+            assert "web-1" not in pods        # evicted
+            assert "ds-pod" in pods           # DaemonSet-owned kept
+            rc, _ = await _cli(store, "uncordon", "n0")
+            node = await store.get("nodes", "n0")
+            assert "unschedulable" not in node["spec"]
+            store.stop()
+        run(body())
+
+    def test_top_nodes(self):
+        async def body():
+            store = await seeded_store()
+            rc, out = await _cli(store, "top", "nodes")
+            assert rc == 0
+            assert "n0" in out and "CPU" in out and "%" in out
+            store.stop()
+        run(body())
+
+
+class TestOverHTTP:
+    def test_cli_through_apiserver(self):
+        """The same verbs work across the wire (RemoteStore)."""
+        async def body():
+            from kubernetes_tpu.apiserver.client import RemoteStore
+            from kubernetes_tpu.apiserver.server import APIServer
+            store = await seeded_store()
+            srv = APIServer(store)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            rc, out = await _cli(rs, "get", "pods")
+            assert rc == 0 and "web-1" in out
+            rc, _ = await _cli(rs, "cordon", "n1")
+            node = await store.get("nodes", "n1")
+            assert node["spec"]["unschedulable"] is True
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
