@@ -30,6 +30,9 @@ PRESETS = {
     "smoke": (100, 200, 1000),
     "1k": (1000, 500, 3000),
     "5k": (5000, 1000, 10000),
+    # config #5 scale: 50k nodes (KWOK-style, nodes are data); the node
+    # dimension is what multi-slice sharding scales (SURVEY §5.7).
+    "50k": (50000, 500, 5000),
 }
 
 

@@ -113,17 +113,16 @@ async def cmd_get(store, args, out) -> int:
             return 0
         items = [obj]
     else:
+        # Namespace filtering happens server-side (store.list supports
+        # namespace=), not by transferring the whole cluster and sifting.
+        ns = None if (resource in CLUSTER_SCOPED or args.all_namespaces) \
+            else args.namespace
+        sel = None
         if args.selector:
             from kubernetes_tpu.api.labels import parse_selector
-            lst = await store.list(
-                resource, selector=parse_selector(args.selector))
-        else:
-            lst = await store.list(resource)
+            sel = parse_selector(args.selector)
+        lst = await store.list(resource, namespace=ns, selector=sel)
         items = lst.items
-        if resource not in CLUSTER_SCOPED and not args.all_namespaces:
-            items = [o for o in items
-                     if o.get("metadata", {}).get("namespace",
-                                                  "default") == args.namespace]
         if args.output in ("yaml", "json"):
             _dump({"kind": "List", "items": items}, args.output, out)
             return 0
@@ -290,6 +289,7 @@ async def cmd_drain(store, args, out) -> int:
     (kubectl drain --ignore-daemonsets semantics)."""
     await _set_unschedulable(store, args.node, True)
     pods = (await store.list("pods")).items
+    failed = 0
     for p in pods:
         if p.get("spec", {}).get("nodeName") != args.node:
             continue
@@ -300,8 +300,13 @@ async def cmd_drain(store, args, out) -> int:
             await store.delete("pods", namespaced_name(p))
             print(f"pod/{p['metadata']['name']} evicted", file=out)
         except StoreError as e:
+            failed += 1
             print(f"Error evicting {p['metadata']['name']}: {e}",
                   file=sys.stderr)
+    if failed:
+        print(f"Error: {failed} pod(s) could not be evicted from "
+              f"{args.node}", file=sys.stderr)
+        return 1
     print(f"node/{args.node} drained", file=out)
     return 0
 

@@ -106,16 +106,26 @@ class GarbageCollectorController(Controller):
             self._dependents.get(ouid, set()).discard(dep)
         if not refs:
             return
+        # Only owners of WATCHED resources enter the graph: a Node-owned
+        # mirror pod (or any unwatched kind) must never be tracked, or the
+        # resync sweep would re-enqueue + re-verify it forever.
         owners = set()
+        collectable = True
         for ref in refs:
+            owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
+            if owner_res is None or owner_res not in self.resources:
+                collectable = False
+                continue
             ouid = ref.get("uid")
             if not ouid:
                 continue
             owners.add(ouid)
             self._dependents.setdefault(ouid, set()).add(dep)
+        if not collectable or not owners:
+            return
         self._owners_of[dep] = owners
         # Owner already gone (or never seen after sync) → collect now.
-        if owners and not any(o in self._alive for o in owners):
+        if not any(o in self._alive for o in owners):
             asyncio.ensure_future(self.queue.add(f"{resource}|{dep[1]}"))
 
     def _on_delete(self, resource: str, obj: dict) -> None:
@@ -154,8 +164,10 @@ class GarbageCollectorController(Controller):
         ns = obj.get("metadata", {}).get("namespace", "default")
         for ref in refs:
             owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
-            if owner_res is None:
-                return  # owner kind unknown → leave the dependent alone
+            if owner_res is None or owner_res not in self.resources:
+                # An owner of an UNWATCHED kind (Node, custom resource,
+                # ...) is never collectable — keep the dependent.
+                return
             owner_key = ref.get("name") \
                 if owner_res in CLUSTER_SCOPED_RESOURCES \
                 else f"{ns}/{ref.get('name')}"

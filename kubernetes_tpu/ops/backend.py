@@ -232,13 +232,18 @@ class TPUBackend:
         self.mesh = mesh
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
-            from kubernetes_tpu.parallel import NODES_AXIS
+            from kubernetes_tpu.parallel import NODES_AXIS, SLICE_AXIS
+            # A multi-slice mesh (config #5) shards the node dimension over
+            # BOTH axes, slice-major: XLA then lowers reductions over the
+            # pair hierarchically (ICI within a slice, DCN across).
+            axis = (SLICE_AXIS, NODES_AXIS) \
+                if SLICE_AXIS in self.mesh.axis_names else NODES_AXIS
             self._sh_nodes_mat = NamedSharding(
-                self.mesh, PartitionSpec(NODES_AXIS, None))
+                self.mesh, PartitionSpec(axis, None))
             self._sh_nodes_vec = NamedSharding(
-                self.mesh, PartitionSpec(NODES_AXIS))
+                self.mesh, PartitionSpec(axis))
             self._sh_pn = NamedSharding(
-                self.mesh, PartitionSpec(None, NODES_AXIS))
+                self.mesh, PartitionSpec(None, axis))
             self._sh_rep = NamedSharding(self.mesh, PartitionSpec())
         self._ct: ClusterTensors | None = None
         # (plugin, sig) -> np row; valid while _row_fp matches.

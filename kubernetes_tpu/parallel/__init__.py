@@ -3,16 +3,21 @@
 from kubernetes_tpu.parallel.mesh import (
     NODES_AXIS,
     PODS_AXIS,
+    SLICE_AXIS,
     build_mesh,
     build_mesh_2d,
+    build_multislice_mesh,
     pad_axis,
 )
 from kubernetes_tpu.parallel.sharded import (
     sharded_greedy_assign,
+    sharded_greedy_assign_multislice,
     sharded_masks_scores,
 )
 
 __all__ = [
-    "NODES_AXIS", "PODS_AXIS", "build_mesh", "build_mesh_2d", "pad_axis",
-    "sharded_greedy_assign", "sharded_masks_scores",
+    "NODES_AXIS", "PODS_AXIS", "SLICE_AXIS",
+    "build_mesh", "build_mesh_2d", "build_multislice_mesh", "pad_axis",
+    "sharded_greedy_assign", "sharded_greedy_assign_multislice",
+    "sharded_masks_scores",
 ]

@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 
 NODES_AXIS = "nodes"
 PODS_AXIS = "pods"
+SLICE_AXIS = "slice"
 
 
 def build_mesh(n_devices: int | None = None) -> Mesh:
@@ -34,6 +35,30 @@ def build_mesh(n_devices: int | None = None) -> Mesh:
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")
     return Mesh(np.array(devs[:n]), (NODES_AXIS,))
+
+
+def build_multislice_mesh(n_slices: int,
+                          chips_per_slice: int | None = None) -> Mesh:
+    """(slice × nodes) mesh — BASELINE config #5's 50k-node shape.
+
+    The outer `slice` axis maps to DCN (cross-slice traffic), the inner
+    `nodes` axis to ICI within a slice; the cluster's node dimension is
+    sharded over BOTH (flattened slice-major), so collectives reduce
+    hierarchically: slice-local first (ICI), one scalar per slice across
+    DCN second. Under the real multi-slice runtime `jax.devices()` orders
+    devices slice-major so rows land on physical slices; on the virtual
+    CPU mesh the grouping is positional (what the dryrun proves)."""
+    devs = jax.devices()
+    if chips_per_slice is None:
+        if len(devs) % n_slices:
+            raise ValueError(
+                f"{len(devs)} devices don't divide into {n_slices} slices")
+        chips_per_slice = len(devs) // n_slices
+    total = n_slices * chips_per_slice
+    if total > len(devs):
+        raise ValueError(f"requested {total} devices, have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(n_slices, chips_per_slice)
+    return Mesh(arr, (SLICE_AXIS, NODES_AXIS))
 
 
 def build_mesh_2d(n_devices: int | None = None,

@@ -213,12 +213,10 @@ class Scheduler:
                   for fwk in self.profiles.values()
                   for p in fwk.plugins
                   for label in getattr(p, "EVENTS", [])}
-        resource_of = {
-            "PersistentVolumeClaim": "persistentvolumeclaims",
-            "PersistentVolume": "persistentvolumes",
-            "StorageClass": "storageclasses",
-            "NodeResourceTopology": "noderesourcetopologies",
-        }
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        resource_of = {k: KIND_TO_RESOURCE[k] for k in (
+            "PersistentVolumeClaim", "PersistentVolume", "StorageClass",
+            "NodeResourceTopology")}
         for kind, resource in resource_of.items():
 
             def poke(action, kind=kind):
