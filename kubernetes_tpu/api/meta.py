@@ -142,6 +142,10 @@ KIND_TO_RESOURCE = {
     "StorageClass": "storageclasses",
     "NodeResourceTopology": "noderesourcetopologies",
     "Service": "services", "Event": "events", "Lease": "leases",
+    "EndpointSlice": "endpointslices",
+    "ResourceQuota": "resourcequotas",
+    "PodDisruptionBudget": "poddisruptionbudgets",
+    "HorizontalPodAutoscaler": "horizontalpodautoscalers",
 }
 
 #: resources without a namespace segment in their keys/URLs.

@@ -297,7 +297,15 @@ async def cmd_drain(store, args, out) -> int:
         if any(r.get("kind") == "DaemonSet" for r in refs):
             continue
         try:
-            await store.delete("pods", namespaced_name(p))
+            # Eviction API first (honors PodDisruptionBudgets); plain
+            # delete when the subresource isn't installed.
+            try:
+                await store.subresource(
+                    "pods", namespaced_name(p), "eviction", {})
+            except NotFound as e:
+                if "not registered" not in str(e):
+                    raise
+                await store.delete("pods", namespaced_name(p))
             print(f"pod/{p['metadata']['name']} evicted", file=out)
         except StoreError as e:
             failed += 1

@@ -14,6 +14,19 @@ from kubernetes_tpu.controllers.garbagecollector import (
     NamespaceController,
 )
 from kubernetes_tpu.controllers.job import JobController, make_job
+from kubernetes_tpu.controllers.longtail import (
+    DisruptionController,
+    EndpointSliceController,
+    HorizontalPodAutoscalerController,
+    ResourceQuotaController,
+    TTLAfterFinishedController,
+    install_eviction_subresource,
+    install_quota_admission,
+    make_hpa,
+    make_pdb,
+    make_resource_quota,
+    make_service,
+)
 from kubernetes_tpu.controllers.kwok import KwokController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
@@ -28,6 +41,14 @@ from kubernetes_tpu.controllers.statefulset import (
 )
 
 __all__ = [
+    "DisruptionController",
+    "EndpointSliceController",
+    "HorizontalPodAutoscalerController",
+    "ResourceQuotaController",
+    "TTLAfterFinishedController",
+    "install_eviction_subresource",
+    "install_quota_admission",
+    "make_hpa", "make_pdb", "make_resource_quota", "make_service",
     "GarbageCollectorController",
     "NamespaceController",
     "Controller", "ControllerManager",

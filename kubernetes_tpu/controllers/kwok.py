@@ -36,6 +36,7 @@ class KwokController(Controller):
         self.lease_period = lease_period
         self.name_prefix = name_prefix
         self._managed: set[str] = set()
+        self._ip_seq = 0  # fake pod IP allocator (see _mark_running)
         self._run_queue: list[str] = []
         self._run_draining = False
         self._stage_tasks: set[asyncio.Task] = set()
@@ -122,6 +123,15 @@ class KwokController(Controller):
             if pod.get("status", {}).get("phase") != "Pending":
                 return None
             pod.setdefault("status", {})["phase"] = "Running"
+            # Fake pod IP (kwok does the same): EndpointSlice endpoints
+            # need addresses. Sequential allocation — unique by
+            # construction (builtin hash() is salted per process and
+            # collides at 50k scale).
+            self._ip_seq += 1
+            q = self._ip_seq
+            pod["status"].setdefault(
+                "podIP",
+                f"10.{(q >> 16) % 256}.{(q >> 8) % 256}.{q % 254 + 1}")
             conds = pod["status"].setdefault("conditions", [])
             if not any(c.get("type") == "Ready" for c in conds):
                 conds.append({"type": "Ready", "status": "True"})

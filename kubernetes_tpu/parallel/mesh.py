@@ -57,6 +57,14 @@ def build_multislice_mesh(n_slices: int,
     total = n_slices * chips_per_slice
     if total > len(devs):
         raise ValueError(f"requested {total} devices, have {len(devs)}")
+    # The backend pads the node axis to multiples of NODE_PAD (256); a
+    # shard count that doesn't divide it fails deep inside XLA sharding —
+    # surface it here instead.
+    from kubernetes_tpu.ops.tensorize import NODE_PAD
+    if NODE_PAD % total:
+        raise ValueError(
+            f"{n_slices}x{chips_per_slice}={total} shards must divide "
+            f"NODE_PAD={NODE_PAD} (use a power-of-two shard count)")
     arr = np.array(devs[:total]).reshape(n_slices, chips_per_slice)
     return Mesh(arr, (SLICE_AXIS, NODES_AXIS))
 

@@ -127,16 +127,27 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                jnp.float32(w_fit), jnp.float32(w_bal))
 
 
-def _solver_fn(mesh: Mesh, strategy: str, local_n: int):
-    key = (mesh, strategy, local_n)
+def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
+               axes: tuple[str, ...] = (NODES_AXIS,)):
+    """One solver body for every mesh shape: the node dimension shards over
+    `axes` (flattened, first axis major). Reductions run innermost-axis
+    first, so a (slice, nodes) pair reduces slice-locally over ICI before
+    ONE scalar per slice crosses DCN — the hierarchical argmax of SURVEY
+    §5.7 falls out of the axis order."""
+    key = (mesh, strategy, local_n, axes)
     fn = _SOLVER_CACHE.get(key)
     if fn is not None:
         return fn
 
-    spec_nr = P(NODES_AXIS, None)
-    spec_n = P(NODES_AXIS)
-    spec_pn = P(None, NODES_AXIS)
+    spec_nr = P(axes, None)
+    spec_n = P(axes)
+    spec_pn = P(None, axes)
     rep = P()
+
+    def _reduce(val, op):
+        for a in reversed(axes):  # innermost (ICI) first, outermost last
+            val = op(val, a)
+        return val
 
     @jax.jit
     @partial(shard_map, mesh=mesh,
@@ -146,7 +157,9 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int):
     def run(req_q, req_nz_q, free_q, free_pods, used_nz, alloc_q,
             mask, static_sc, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal):
-        shard = lax.axis_index(NODES_AXIS)
+        shard = jnp.int32(0)
+        for a in axes:
+            shard = shard * lax.axis_size(a) + lax.axis_index(a)
         base = (shard * local_n).astype(jnp.int32)
         iota = jnp.arange(local_n, dtype=jnp.int32)
 
@@ -161,11 +174,10 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int):
             sc = sc + w_bal * kernels.balanced_allocation_score(
                 alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
             masked = jnp.where(fits, sc, -jnp.inf)
-            lbest = jnp.max(masked)
-            gbest = lax.pmax(lbest, NODES_AXIS)
+            gbest = _reduce(jnp.max(masked), lax.pmax)
             # Tie-break: lowest global index among shards holding gbest.
             cand = jnp.where(masked >= gbest, iota + base, _INT_MAX)
-            gidx = lax.pmin(jnp.min(cand), NODES_AXIS)
+            gidx = _reduce(jnp.min(cand), lax.pmin)
             chosen = jnp.where(jnp.isfinite(gbest), gidx, jnp.int32(-1))
             hit = (iota + base) == chosen
             free_q = free_q - jnp.where(hit[:, None], req[None, :], 0)
@@ -191,82 +203,20 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
                                      static_scores, fit_col_w, bal_col_mask,
                                      shape_u, shape_s, w_fit, w_bal,
                                      strategy: str):
-    """Sequential-equivalent greedy over a (slice × nodes) mesh with the
-    HIERARCHICAL reduce of SURVEY §5.7: each scan step finds the
-    shard-local best, reduces slice-locally over ICI (`pmax` on the nodes
-    axis), then reduces ONE scalar per slice across DCN (`pmax` on the
-    slice axis) — cross-slice traffic is O(1) per pod regardless of node
-    count, which is what makes the 50k-node configuration viable over DCN
-    bandwidth. Tie-break matches the single-device solver (lowest global
-    node index)."""
+    """Sequential-equivalent greedy over a (slice × nodes) mesh: the same
+    solver body as `sharded_greedy_assign`, with the node dimension sharded
+    over BOTH axes and the per-step argmax reduced hierarchically —
+    slice-local `pmax` over ICI, then ONE scalar per slice across DCN, so
+    cross-slice traffic is O(1) per pod regardless of node count (the 50k
+    config #5 enabler). Tie-break matches the single-device solver."""
     s_shards = mesh.shape[SLICE_AXIS]
     n_shards = mesh.shape[NODES_AXIS]
     n_total = free_q.shape[0]
     shards = s_shards * n_shards
     assert n_total % shards == 0, (n_total, shards)
-    run = _ms_solver_fn(mesh, strategy, n_total // shards)
+    run = _solver_fn(mesh, strategy, n_total // shards,
+                     axes=(SLICE_AXIS, NODES_AXIS))
     return run(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
                mask, static_scores, fit_col_w, bal_col_mask,
                jnp.asarray(shape_u), jnp.asarray(shape_s),
                jnp.float32(w_fit), jnp.float32(w_bal))
-
-
-def _ms_solver_fn(mesh: Mesh, strategy: str, local_n: int):
-    key = ("ms", mesh, strategy, local_n)
-    fn = _SOLVER_CACHE.get(key)
-    if fn is not None:
-        return fn
-
-    both = (SLICE_AXIS, NODES_AXIS)
-    spec_nr = P(both, None)
-    spec_n = P(both)
-    spec_pn = P(None, both)
-    rep = P()
-
-    @jax.jit
-    @partial(shard_map, mesh=mesh,
-             in_specs=(rep, rep, spec_nr, spec_n, spec_nr, spec_nr,
-                       spec_pn, spec_pn, rep, rep, rep, rep, rep, rep),
-             out_specs=rep, check_vma=False)
-    def run(req_q, req_nz_q, free_q, free_pods, used_nz, alloc_q,
-            mask, static_sc, fit_col_w, bal_col_mask, shape_u, shape_s,
-            w_fit, w_bal):
-        n_shards = lax.axis_size(NODES_AXIS)
-        shard = (lax.axis_index(SLICE_AXIS) * n_shards
-                 + lax.axis_index(NODES_AXIS))
-        base = (shard * local_n).astype(jnp.int32)
-        iota = jnp.arange(local_n, dtype=jnp.int32)
-
-        def step(carry, inp):
-            free_q, free_pods, used_nz = carry
-            req, req_nz, m, sc_static = inp
-            fits = m & jnp.all(req[None, :] <= free_q, axis=1) \
-                & (free_pods >= 1)
-            sc = sc_static
-            sc = sc + w_fit * kernels.fit_score(
-                alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
-                shape_u, shape_s)[0]
-            sc = sc + w_bal * kernels.balanced_allocation_score(
-                alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
-            masked = jnp.where(fits, sc, -jnp.inf)
-            # Hierarchical argmax: ICI first, then one scalar over DCN.
-            lbest = jnp.max(masked)
-            slice_best = lax.pmax(lbest, NODES_AXIS)        # intra-slice
-            gbest = lax.pmax(slice_best, SLICE_AXIS)        # cross-slice
-            cand = jnp.where(masked >= gbest, iota + base, _INT_MAX)
-            slice_idx = lax.pmin(jnp.min(cand), NODES_AXIS)
-            gidx = lax.pmin(slice_idx, SLICE_AXIS)
-            chosen = jnp.where(jnp.isfinite(gbest), gidx, jnp.int32(-1))
-            hit = (iota + base) == chosen
-            free_q = free_q - jnp.where(hit[:, None], req[None, :], 0)
-            free_pods = free_pods - hit.astype(jnp.int32)
-            used_nz = used_nz + jnp.where(hit[:, None], req_nz[None, :], 0)
-            return (free_q, free_pods, used_nz), chosen
-
-        (_, _, _), assign = lax.scan(
-            step, (free_q, free_pods, used_nz),
-            (req_q, req_nz_q, mask, static_sc))
-        return assign
-
-    _SOLVER_CACHE[key] = run
-    return run

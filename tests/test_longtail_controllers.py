@@ -1,0 +1,263 @@
+"""Controller long tail (SURVEY §2.4 bottom rows): EndpointSlice,
+ResourceQuota + admission, Disruption/PDB + eviction API, TTL-after-
+finished, HPA."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    DisruptionController,
+    EndpointSliceController,
+    HorizontalPodAutoscalerController,
+    KwokController,
+    ResourceQuotaController,
+    TTLAfterFinishedController,
+    install_eviction_subresource,
+    install_quota_admission,
+    make_hpa,
+    make_pdb,
+    make_resource_quota,
+    make_service,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.store.mvcc import Conflict, Invalid
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.03):
+    for _ in range(int(timeout / interval)):
+        v = await predicate()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return await predicate()
+
+
+async def stack(controllers, *, kwok=False, scheduler=False):
+    store = new_cluster_store()
+    install_core_validation(store)
+    ctrls = [c(store) for c in controllers]
+    kw = None
+    if kwok:
+        kw = KwokController(store, node_count=3, lease_period=0.5)
+        await kw.register_nodes()
+        ctrls.append(kw)
+    else:
+        for i in range(3):
+            await store.create("nodes", make_node(f"n{i}"))
+    mgr = ControllerManager(store, ctrls)
+    await mgr.start()
+    sched_task = None
+    sched = None
+    factory = None
+    if scheduler:
+        from kubernetes_tpu.client import InformerFactory
+        from kubernetes_tpu.scheduler import Scheduler
+        sched = Scheduler(store, seed=2)
+        factory = InformerFactory(store)
+        await sched.setup_informers(factory)
+        factory.start()
+        await factory.wait_for_sync()
+        sched_task = asyncio.ensure_future(sched.run())
+
+    async def teardown():
+        if sched is not None:
+            await sched.stop()
+            sched_task.cancel()
+            factory.stop()
+        await mgr.stop()
+        store.stop()
+    return store, teardown
+
+
+class TestEndpointSlice:
+    def test_service_gets_ready_endpoints(self):
+        async def body():
+            store, teardown = await stack(
+                [EndpointSliceController], kwok=True, scheduler=True)
+            await store.create("services", make_service(
+                "web", {"app": "web"}))
+            for i in range(3):
+                await store.create("pods", make_pod(
+                    f"w{i}", labels={"app": "web"},
+                    requests={"cpu": "100m"}))
+            await store.create("pods", make_pod(
+                "other", labels={"app": "db"}, requests={"cpu": "100m"}))
+
+            async def three_ready():
+                try:
+                    eps = await store.get("endpointslices", "default/web")
+                except Exception:
+                    return False
+                eps_list = eps.get("endpoints") or []
+                return len(eps_list) == 3 and all(
+                    e["conditions"]["ready"] for e in eps_list)
+            assert await wait_for(three_ready)
+            eps = await store.get("endpointslices", "default/web")
+            names = {e["targetRef"]["name"] for e in eps["endpoints"]}
+            assert names == {"w0", "w1", "w2"}
+            assert all(e["addresses"][0].startswith("10.")
+                       for e in eps["endpoints"])
+            # Pod deletion shrinks the slice.
+            await store.delete("pods", "default/w0")
+
+            async def two():
+                eps = await store.get("endpointslices", "default/web")
+                return len(eps.get("endpoints") or []) == 2
+            assert await wait_for(two)
+            await teardown()
+        run(body())
+
+
+class TestResourceQuota:
+    def test_admission_rejects_over_quota(self):
+        async def body():
+            store, teardown = await stack([ResourceQuotaController])
+            install_quota_admission(store)
+            await store.create("resourcequotas", make_resource_quota(
+                "team", {"pods": "2", "cpu": "1"}))
+            await store.create("pods", make_pod(
+                "a", requests={"cpu": "400m"}))
+            await store.create("pods", make_pod(
+                "b", requests={"cpu": "400m"}))
+            # third pod: over the pods=2 limit
+            with pytest.raises(Invalid):
+                await store.create("pods", make_pod(
+                    "c", requests={"cpu": "100m"}))
+            # cpu limit binds even under the pod count
+            await store.delete("pods", "default/b")
+            with pytest.raises(Invalid):
+                await store.create("pods", make_pod(
+                    "big", requests={"cpu": "700m"}))
+            # status.used is published by the controller
+            async def used():
+                rq = await store.get("resourcequotas", "default/team")
+                return (rq.get("status") or {}).get("used", {}).get("pods") \
+                    == "1"
+            assert await wait_for(used)
+            await teardown()
+        run(body())
+
+
+class TestDisruption:
+    def test_eviction_respects_pdb(self):
+        async def body():
+            store, teardown = await stack(
+                [DisruptionController], kwok=True, scheduler=True)
+            install_eviction_subresource(store)
+            await store.create("poddisruptionbudgets", make_pdb(
+                "web-pdb", {"matchLabels": {"app": "web"}},
+                min_available=2))
+            for i in range(3):
+                await store.create("pods", make_pod(
+                    f"w{i}", labels={"app": "web"},
+                    requests={"cpu": "100m"}))
+
+            async def budget_ready():
+                pdb = await store.get(
+                    "poddisruptionbudgets", "default/web-pdb")
+                st = pdb.get("status") or {}
+                return st.get("currentHealthy") == 3 and \
+                    st.get("disruptionsAllowed") == 1
+            assert await wait_for(budget_ready)
+            # First eviction allowed (3 healthy, min 2)...
+            await store.subresource("pods", "default/w0", "eviction", {})
+
+            async def one_allowed_gone():
+                pdb = await store.get(
+                    "poddisruptionbudgets", "default/web-pdb")
+                return (pdb.get("status") or {}).get(
+                    "disruptionsAllowed") == 0
+            assert await wait_for(one_allowed_gone)
+            # ...second refused: budget exhausted.
+            with pytest.raises(Conflict):
+                await store.subresource("pods", "default/w1", "eviction", {})
+            await teardown()
+        run(body())
+
+
+class TestEvictionRace:
+    def test_back_to_back_evictions_cannot_break_budget(self):
+        """The eviction handler recounts LIVE state, so a tight eviction
+        loop (ktpuctl drain) cannot overshoot the budget while the
+        controller's status lags."""
+        async def body():
+            store, teardown = await stack([], kwok=True, scheduler=True)
+            install_eviction_subresource(store)
+            await store.create("poddisruptionbudgets", make_pdb(
+                "pdb", {"matchLabels": {"app": "web"}}, min_available=2))
+            for i in range(3):
+                await store.create("pods", make_pod(
+                    f"w{i}", labels={"app": "web"},
+                    requests={"cpu": "100m"}))
+
+            async def all_ready():
+                pods = (await store.list("pods")).items
+                return sum(1 for p in pods
+                           if p["status"].get("phase") == "Running") == 3
+            assert await wait_for(all_ready)
+            # NO DisruptionController running: status is absent/stale.
+            # First eviction OK, second must refuse (2 healthy left).
+            await store.subresource("pods", "default/w0", "eviction", {})
+            with pytest.raises(Conflict):
+                await store.subresource("pods", "default/w1", "eviction", {})
+            pods = (await store.list("pods")).items
+            assert len(pods) == 2
+            await teardown()
+        run(body())
+
+
+class TestTTLAfterFinished:
+    def test_finished_job_deleted_after_ttl(self):
+        async def body():
+            store, teardown = await stack([TTLAfterFinishedController])
+            from kubernetes_tpu.api.meta import now_iso
+            job = {
+                "apiVersion": "batch/v1", "kind": "Job",
+                "metadata": {"name": "done", "namespace": "default"},
+                "spec": {"ttlSecondsAfterFinished": 0},
+                "status": {"conditions": [{
+                    "type": "Complete", "status": "True",
+                    "lastTransitionTime": now_iso()}]},
+            }
+            await store.create("jobs", job)
+
+            async def gone():
+                return not (await store.list("jobs")).items
+            assert await wait_for(gone)
+            await teardown()
+        run(body())
+
+
+class TestHPA:
+    def test_scales_up_on_load(self):
+        async def body():
+            store, teardown = await stack(
+                [HorizontalPodAutoscalerController])
+            await store.create("deployments", {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 2,
+                         "selector": {"matchLabels": {"app": "web"}}}})
+            for i in range(2):
+                pod = make_pod(f"w{i}", labels={"app": "web"},
+                               requests={"cpu": "100m"}, phase="Running")
+                pod["metadata"]["annotations"] = {"ktpu.dev/load": "160"}
+                await store.create("pods", pod)
+            await store.create(
+                "horizontalpodautoscalers",
+                make_hpa("web-hpa", "deployments/web", max_replicas=8,
+                         target_utilization=80))
+
+            async def scaled():
+                d = await store.get("deployments", "default/web")
+                return d["spec"]["replicas"] == 4  # ceil(2 * 160/80)
+            assert await wait_for(scaled)
+            await teardown()
+        run(body())
