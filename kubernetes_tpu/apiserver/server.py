@@ -155,6 +155,7 @@ class APIServer:
                  priority_levels: Mapping[str, PriorityLevel] | None = None,
                  bearer_tokens: Mapping[str, str] | None = None,
                  authorizer=None,
+                 admission=None,
                  metrics_registry=None,
                  audit_log: bool = False):
         self.store = store
@@ -170,6 +171,9 @@ class APIServer:
         #: RBACAuthorizer (apiserver/rbac.py) or None = authz disabled
         #: (the reference's AlwaysAllow mode).
         self.authorizer = authorizer
+        #: WebhookAdmission (apiserver/admission.py) or None = no
+        #: mutating/validating webhook out-calls.
+        self.admission = admission
         self.metrics_registry = metrics_registry
         self.audit_log = audit_log
         self._runner: web.AppRunner | None = None
@@ -355,6 +359,8 @@ class APIServer:
                     "metadata", {}).get("namespace"):
                 obj.setdefault("metadata", {})["namespace"] = \
                     request["namespace"]
+            if self.admission is not None:
+                obj = await self.admission.admit(obj, resource, "create")
             created = await self.store.create(resource, obj)
             return web.json_response(created, status=201)
         raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST"])
@@ -371,6 +377,8 @@ class APIServer:
             meta.setdefault("name", request.match_info["name"])
             if request["namespace"]:
                 meta.setdefault("namespace", request["namespace"])
+            if self.admission is not None:
+                obj = await self.admission.admit(obj, resource, "update")
             return web.json_response(await self.store.update(resource, obj))
         if request.method == "DELETE":
             uid = None
@@ -471,6 +479,8 @@ class APIServer:
         return f"http://{self.host}:{self.port}"
 
     async def stop(self) -> None:
+        if self.admission is not None:
+            await self.admission.close()
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

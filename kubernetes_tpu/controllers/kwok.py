@@ -128,10 +128,9 @@ class KwokController(Controller):
             # construction (builtin hash() is salted per process and
             # collides at 50k scale).
             self._ip_seq += 1
-            q = self._ip_seq
+            hi, lo = divmod(self._ip_seq, 254)
             pod["status"].setdefault(
-                "podIP",
-                f"10.{(q >> 16) % 256}.{(q >> 8) % 256}.{q % 254 + 1}")
+                "podIP", f"10.{(hi >> 8) % 256}.{hi % 256}.{lo + 1}")
             conds = pod["status"].setdefault("conditions", [])
             if not any(c.get("type") == "Ready" for c in conds):
                 conds.append({"type": "Ready", "status": "True"})

@@ -116,9 +116,15 @@ class EndpointSliceController(Controller):
                     asyncio.ensure_future(
                         self.queue.add(namespaced_name(svc)))
 
+        def pod_updated(old, new):
+            # Both label sets: a relabel can REMOVE the pod from a
+            # service's selector (the reference processes old and new).
+            pod_changed(old)
+            pod_changed(new)
+
         self.pod_informer.add_event_handler(ResourceEventHandler(
             on_add=pod_changed,
-            on_update=lambda old, new: pod_changed(new),
+            on_update=pod_updated,
             on_delete=pod_changed))
 
     async def resync_keys(self):
@@ -264,9 +270,21 @@ def install_quota_admission(store) -> None:
             return
         used = _quota_usage(list(store._table("pods").values()), ns)
         reqs = pod_requests(pod)
-        want = {"pods": used["pods"] + 1,
-                "cpu": used["cpu"] + reqs.get("cpu", 0),
-                "memory": used["memory"] + reqs.get("memory", 0)}
+        # On update the old pod is still in the table: credit its usage
+        # back, or a replace could double-count (and quota would be
+        # bypassable by raising requests via PUT).
+        key = f"{ns}/{pod.get('metadata', {}).get('name', '')}"
+        old = store._table("pods").get(key)
+        delta_pods = 1
+        old_cpu = old_mem = 0
+        if old is not None and not pod_is_terminal(old):
+            delta_pods = 0
+            old_reqs = pod_requests(old)
+            old_cpu = old_reqs.get("cpu", 0)
+            old_mem = old_reqs.get("memory", 0)
+        want = {"pods": used["pods"] + delta_pods,
+                "cpu": used["cpu"] - old_cpu + reqs.get("cpu", 0),
+                "memory": used["memory"] - old_mem + reqs.get("memory", 0)}
         from kubernetes_tpu.store.mvcc import Invalid
         for q in quotas:
             for k, limit in (q.get("spec", {}).get("hard") or {}).items():
@@ -279,8 +297,10 @@ def install_quota_admission(store) -> None:
                         f"exceeded quota {name_of(q)!r}: requested "
                         f"{base} would exceed hard limit {limit}")
 
-    # Create-only, like the reference (updates can't change pod requests).
-    store.register_mutator("pods", check, on=("create",))
+    # Both operations: this store's update() is a full replace with no
+    # spec-immutability validation, so PUT could otherwise raise requests
+    # past the quota.
+    store.register_mutator("pods", check, on=("create", "update"))
 
 
 class DisruptionController(Controller):
@@ -352,7 +372,19 @@ def _disruptions_allowed(pdb: dict, expected: int, healthy: int) -> int:
 def install_eviction_subresource(store) -> None:
     """POST pods/<key>/eviction (EvictionREST): voluntary eviction that a
     PDB with zero disruptionsAllowed refuses with Conflict (429/
-    TooManyRequests in the reference's wire form)."""
+    TooManyRequests in the reference's wire form). Also installs the
+    reference's PDB validation (exactly one of minAvailable /
+    maxUnavailable) — a field-less PDB would block every eviction."""
+    from kubernetes_tpu.store.mvcc import Invalid
+
+    def validate_pdb(pdb: dict) -> None:
+        spec = pdb.get("spec") or {}
+        if ("minAvailable" in spec) == ("maxUnavailable" in spec):
+            raise Invalid(
+                "PodDisruptionBudget: exactly one of minAvailable or "
+                "maxUnavailable must be set")
+
+    store.register_validator("poddisruptionbudgets", validate_pdb)
 
     async def evict(store_, key: str, body) -> dict:
         pod = await store_.get("pods", key)
@@ -413,7 +445,7 @@ class TTLAfterFinishedController(Controller):
         if not done:
             return
         raw = done[0].get("lastTransitionTime")
-        finished_at = 0.0
+        finished_at = None
         if isinstance(raw, (int, float)):
             finished_at = float(raw)
         elif isinstance(raw, str):
@@ -423,6 +455,8 @@ class TTLAfterFinishedController(Controller):
                     raw.replace("Z", "+00:00")).timestamp()
             except ValueError:
                 pass
+        if finished_at is None:
+            return  # nil completion time → never TTL-delete (reference)
         if time.time() - finished_at < float(ttl):
             return  # not due yet; the 1s resync re-enqueues it
         try:

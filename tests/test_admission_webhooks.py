@@ -1,0 +1,194 @@
+"""Admission webhooks (mutating + validating, §3.2's HTTPS out-calls)
+and CustomResourceDefinition support."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.apiserver.admission import (
+    WebhookAdmission,
+    apply_json_patch,
+    install_crd_support,
+    make_crd,
+    validate_against_schema,
+)
+from kubernetes_tpu.apiserver.client import RemoteStore
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.store.mvcc import Invalid, StoreError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _webhook_server(handler):
+    """Tiny HTTP server playing the webhook sidecar."""
+    app = web.Application()
+    app.router.add_post("/hook", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/hook"
+
+
+class TestJsonPatch:
+    def test_add_replace_remove(self):
+        obj = {"metadata": {"labels": {"a": "1"}},
+               "spec": {"containers": [{"name": "c"}]}}
+        out = apply_json_patch(obj, [
+            {"op": "add", "path": "/metadata/labels/b", "value": "2"},
+            {"op": "replace", "path": "/metadata/labels/a", "value": "9"},
+            {"op": "remove", "path": "/spec/containers/0/name"},
+            {"op": "add", "path": "/spec/containers/-",
+             "value": {"name": "sidecar"}},
+        ])
+        assert out["metadata"]["labels"] == {"a": "9", "b": "2"}
+        assert out["spec"]["containers"] == [{}, {"name": "sidecar"}]
+
+
+class TestWebhooks:
+    def test_mutating_then_validating_over_http(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+
+            async def mutate(request):
+                review = await request.json()
+                assert review["request"]["operation"] == "CREATE"
+                return web.json_response({"response": {
+                    "allowed": True,
+                    "patch": [{"op": "add",
+                               "path": "/metadata/labels",
+                               "value": {"injected": "true"}}]}})
+
+            async def validate(request):
+                review = await request.json()
+                meta = review["request"]["object"]["metadata"]
+                ok = (meta.get("labels") or {}).get("injected") == "true" \
+                    and (meta.get("annotations") or {}).get(
+                        "forbidden") != "true"
+                return web.json_response({"response": {
+                    "allowed": ok,
+                    "status": {"message": "forbidden label"}}})
+
+            r1, mutate_url = await _webhook_server(mutate)
+            r2, validate_url = await _webhook_server(validate)
+            await store.create("mutatingwebhookconfigurations", {
+                "kind": "MutatingWebhookConfiguration",
+                "metadata": {"name": "m"},
+                "webhooks": [{"name": "inject.ktpu.dev",
+                              "clientConfig": {"url": mutate_url},
+                              "rules": [{"resources": ["pods"],
+                                         "operations": ["CREATE"]}]}]})
+            await store.create("validatingwebhookconfigurations", {
+                "kind": "ValidatingWebhookConfiguration",
+                "metadata": {"name": "v"},
+                "webhooks": [{"name": "check.ktpu.dev",
+                              "clientConfig": {"url": validate_url},
+                              "rules": [{"resources": ["pods"],
+                                         "operations": ["*"]}]}]})
+            srv = APIServer(store, admission=WebhookAdmission(store))
+            await srv.start()
+            rs = RemoteStore(srv.url)
+
+            created = await rs.create("pods", make_pod("a"))
+            # Mutating webhook injected the label; validator passed it.
+            assert created["metadata"]["labels"]["injected"] == "true"
+
+            bad = make_pod("b")
+            bad["metadata"]["annotations"] = {"forbidden": "true"}
+            with pytest.raises(StoreError) as exc:
+                await rs.create("pods", bad)
+            assert "denied the request" in str(exc.value)
+
+            await rs.close()
+            await srv.stop()
+            await r1.cleanup()
+            await r2.cleanup()
+            store.stop()
+        run(body())
+
+    def test_failure_policy(self):
+        async def body():
+            store = new_cluster_store()
+            adm = WebhookAdmission(store, timeout=0.5)
+            await store.create("validatingwebhookconfigurations", {
+                "kind": "ValidatingWebhookConfiguration",
+                "metadata": {"name": "down"},
+                "webhooks": [{"name": "ignore.ktpu.dev",
+                              "clientConfig": {
+                                  "url": "http://127.0.0.1:1/hook"},
+                              "failurePolicy": "Ignore",
+                              "rules": [{"resources": ["pods"]}]}]})
+            # Ignore → unreachable webhook is skipped.
+            out = await adm.admit(make_pod("a"), "pods", "create")
+            assert out["metadata"]["name"] == "a"
+            await store.create("validatingwebhookconfigurations", {
+                "kind": "ValidatingWebhookConfiguration",
+                "metadata": {"name": "hard"},
+                "webhooks": [{"name": "fail.ktpu.dev",
+                              "clientConfig": {
+                                  "url": "http://127.0.0.1:1/hook"},
+                              "failurePolicy": "Fail",
+                              "rules": [{"resources": ["pods"]}]}]})
+            with pytest.raises(Invalid):
+                await adm.admit(make_pod("b"), "pods", "create")
+            await adm.close()
+            store.stop()
+        run(body())
+
+
+class TestCRDs:
+    def test_crd_registers_resource_with_schema(self):
+        async def body():
+            store = new_cluster_store()
+            install_crd_support(store)
+            await store.create("customresourcedefinitions", make_crd(
+                "tpujobs", "TPUJob", schema={
+                    "type": "object",
+                    "required": ["slices"],
+                    "properties": {
+                        "slices": {"type": "integer"},
+                        "topology": {"type": "string",
+                                     "enum": ["2x2", "2x4", "4x4"]},
+                    }}))
+            # Valid custom object round-trips.
+            await store.create("tpujobs", {
+                "apiVersion": "ktpu.dev/v1", "kind": "TPUJob",
+                "metadata": {"name": "train", "namespace": "default"},
+                "spec": {"slices": 4, "topology": "2x4"}})
+            got = await store.get("tpujobs", "default/train")
+            assert got["spec"]["slices"] == 4
+            # Schema violations rejected.
+            with pytest.raises(Invalid):
+                await store.create("tpujobs", {
+                    "kind": "TPUJob",
+                    "metadata": {"name": "bad", "namespace": "default"},
+                    "spec": {"topology": "2x4"}})   # missing slices
+            with pytest.raises(Invalid):
+                await store.create("tpujobs", {
+                    "kind": "TPUJob",
+                    "metadata": {"name": "bad2", "namespace": "default"},
+                    "spec": {"slices": 2, "topology": "3x3"}})  # enum
+            # The kind→resource mapping makes ktpuctl/GC aware of it.
+            from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+            assert KIND_TO_RESOURCE["TPUJob"] == "tpujobs"
+            store.stop()
+        run(body())
+
+    def test_schema_validator_primitives(self):
+        validate_against_schema({"a": 1}, {
+            "type": "object", "properties": {"a": {"type": "integer"}}})
+        with pytest.raises(Invalid):
+            validate_against_schema(
+                {"a": "x"},
+                {"type": "object",
+                 "properties": {"a": {"type": "integer"}}}, "t")
+        with pytest.raises(Invalid):
+            validate_against_schema([1, "x"], {
+                "type": "array", "items": {"type": "integer"}}, "t")
