@@ -93,6 +93,9 @@ class WebhookAdmission:
     async def _post(self, url: str, review: dict) -> dict:
         import aiohttp
         if self._session is None:
+            # Synchronous check+construct+assign (no await between them):
+            # atomic under a single event loop, so concurrent admits can't
+            # double-create the session.
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.timeout))
         async with self._session.post(url, json=review) as resp:
@@ -123,7 +126,21 @@ class WebhookAdmission:
                     raise Invalid(self._deny_msg(wh, resp))
                 patch = resp.get("patch")
                 if patch:
-                    obj = apply_json_patch(obj, patch)
+                    try:
+                        obj = apply_json_patch(obj, patch)
+                    except (Invalid, KeyError, ValueError, IndexError,
+                            TypeError) as e:
+                        # A bad patch is a webhook failure, subject to its
+                        # failurePolicy (the reference behavior) — not a
+                        # raw 500.
+                        if wh.get("failurePolicy", "Ignore") == "Fail":
+                            raise Invalid(
+                                f'admission webhook '
+                                f'"{wh.get("name", "?")}" returned an '
+                                f"invalid patch: {e}") from e
+                        logger.warning(
+                            "ignoring invalid patch from webhook %s: %s",
+                            wh.get("name"), e)
         for cfg in self._configs("validatingwebhookconfigurations"):
             for wh in cfg.get("webhooks") or []:
                 if not _rules_match(wh, resource, operation):
@@ -235,6 +252,15 @@ def install_crd_support(store) -> None:
     CRD's job here is semantics, exactly the apiextensions-apiserver
     split.)"""
 
+    registered: set[str] = set()
+
+    def _crd_for(plural: str) -> dict | None:
+        for crd in store._table("customresourcedefinitions").values():
+            names = (crd.get("spec") or {}).get("names") or {}
+            if names.get("plural") == plural:
+                return crd
+        return None
+
     def register(crd: dict) -> None:
         spec = crd.get("spec") or {}
         names = spec.get("names") or {}
@@ -245,20 +271,30 @@ def install_crd_support(store) -> None:
         KIND_TO_RESOURCE.setdefault(kind, plural)
         if spec.get("scope") == "Cluster":
             CLUSTER_SCOPED_RESOURCES.add(plural)
-        schema = None
-        for v in spec.get("versions") or []:
-            if v.get("storage") or schema is None:
-                schema = (v.get("schema") or {}).get("openAPIV3Schema")
-        if schema:
-            def validate(obj, schema=schema, kind=kind):
+        if plural in registered:
+            return  # one live-reading validator per plural is enough
+        registered.add(plural)
+
+        def validate(obj, plural=plural, kind=kind):
+            # Read the CURRENT CRD each time: schema updates / delete +
+            # re-create take effect immediately, and a deleted CRD stops
+            # validating (stale-closure validators would enforce forever).
+            live = _crd_for(plural)
+            if live is None:
+                return
+            schema = None
+            for v in (live.get("spec") or {}).get("versions") or []:
+                if v.get("storage") or schema is None:
+                    schema = (v.get("schema") or {}).get("openAPIV3Schema")
+            if schema:
                 validate_against_schema(obj.get("spec", obj), schema,
                                         path=kind + ".spec"
                                         if "spec" in obj else kind)
-            store.register_validator(plural, validate)
+        store.register_validator(plural, validate)
         logger.info("CRD registered: %s (kind %s)", plural, kind)
 
     store.register_mutator("customresourcedefinitions", register,
-                           on=("create",))
+                           on=("create", "update"))
 
     # CRDs created before install (store load) register too.
     for crd in list(store._table("customresourcedefinitions").values()):

@@ -1,0 +1,338 @@
+"""gRPC + protobuf wire for the store (SURVEY §5.8's "gRPC variant").
+
+Parity notes: the reference's core components speak protobuf over HTTP/2
+(`application/vnd.kubernetes.protobuf`), with objects carried in a
+`runtime.Unknown` envelope — TypeMeta plus raw payload bytes. This wire
+is exactly that shape (`Unknown{api_version, kind, raw, content_type}`,
+raw = JSON bytes), over grpc.aio. The service surface mirrors
+`storage.Interface`: Get/List/Create/Update/Delete/Subresource unary
+calls plus a server-streaming Watch with BOOKMARK frames and
+OUT_OF_RANGE for expired resourceVersions (the 410 analog).
+
+`GRPCRemoteStore` is MVCCStore-shaped: informers/controllers/scheduler
+run over it unchanged, like the HTTP RemoteStore.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import sys
+from pathlib import Path
+
+import grpc
+
+sys.path.insert(0, str(Path(__file__).parent / "proto"))
+import ktpu_pb2  # noqa: E402  (protoc --python_out output)
+
+from kubernetes_tpu.api.labels import (  # noqa: E402
+    Selector,
+    parse_selector,
+    selector_to_string,
+)
+from kubernetes_tpu.store.mvcc import (  # noqa: E402
+    AlreadyExists,
+    Conflict,
+    Expired,
+    Invalid,
+    MVCCStore,
+    NotFound,
+    StoreError,
+)
+
+logger = logging.getLogger(__name__)
+
+_SERVICE = "ktpu.Store"
+
+_CODE_OF = {
+    NotFound: grpc.StatusCode.NOT_FOUND,
+    AlreadyExists: grpc.StatusCode.ALREADY_EXISTS,
+    Conflict: grpc.StatusCode.ABORTED,
+    Invalid: grpc.StatusCode.INVALID_ARGUMENT,
+    Expired: grpc.StatusCode.OUT_OF_RANGE,
+}
+_ERR_OF = {v: k for k, v in _CODE_OF.items()}
+
+
+def _wrap(obj: dict) -> "ktpu_pb2.Unknown":
+    return ktpu_pb2.Unknown(
+        api_version=obj.get("apiVersion", ""),
+        kind=obj.get("kind", ""),
+        raw=json.dumps(obj).encode(),
+        content_type="application/json")
+
+
+def _unwrap(u: "ktpu_pb2.Unknown") -> dict:
+    return json.loads(u.raw.decode()) if u.raw else {}
+
+
+def _abort_code(e: StoreError) -> grpc.StatusCode:
+    for cls, code in _CODE_OF.items():
+        if isinstance(e, cls):
+            return code
+    return grpc.StatusCode.INTERNAL
+
+
+class StoreService:
+    """grpc.aio service over one MVCCStore."""
+
+    def __init__(self, store: MVCCStore):
+        self.store = store
+
+    async def Get(self, request, context):
+        try:
+            obj = await self.store.get(request.resource, request.key)
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+        return _wrap(obj)
+
+    async def List(self, request, context):
+        sel = parse_selector(request.label_selector) \
+            if request.label_selector else None
+        try:
+            lst = await self.store.list(
+                request.resource,
+                namespace=request.namespace or None,
+                selector=sel, limit=request.limit,
+                continue_key=request.continue_key or None)
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+        return ktpu_pb2.ListResponse(
+            items=[_wrap(o) for o in lst.items],
+            resource_version=str(lst.resource_version))
+
+    async def Create(self, request, context):
+        try:
+            obj = await self.store.create(
+                request.resource, _unwrap(request.object))
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+        return _wrap(obj)
+
+    async def Update(self, request, context):
+        try:
+            obj = await self.store.update(
+                request.resource, _unwrap(request.object))
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+        return _wrap(obj)
+
+    async def Delete(self, request, context):
+        try:
+            obj = await self.store.delete(
+                request.resource, request.key, uid=request.uid or None)
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+        return _wrap(obj)
+
+    async def Subresource(self, request, context):
+        try:
+            obj = await self.store.subresource(
+                request.resource, request.key, request.subresource,
+                _unwrap(request.body))
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+        return _wrap(obj)
+
+    async def Watch(self, request, context):
+        sel = parse_selector(request.label_selector) \
+            if request.label_selector else None
+        rv = int(request.resource_version) \
+            if request.resource_version else 0
+        try:
+            async for ev in await self.store.watch(
+                    request.resource, resource_version=rv, selector=sel):
+                yield ktpu_pb2.WatchEvent(
+                    type=ev.type, object=_wrap(ev.object))
+        except Expired as e:
+            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+        except StoreError as e:
+            await context.abort(_abort_code(e), str(e))
+
+
+def _handlers(svc: StoreService) -> grpc.GenericRpcHandler:
+    def uu(fn, req_cls, resp_cls=ktpu_pb2.Unknown):
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString)
+
+    method_handlers = {
+        "Get": uu(svc.Get, ktpu_pb2.GetRequest),
+        "List": uu(svc.List, ktpu_pb2.ListRequest, ktpu_pb2.ListResponse),
+        "Create": uu(svc.Create, ktpu_pb2.CreateRequest),
+        "Update": uu(svc.Update, ktpu_pb2.UpdateRequest),
+        "Delete": uu(svc.Delete, ktpu_pb2.DeleteRequest),
+        "Subresource": uu(svc.Subresource, ktpu_pb2.SubresourceRequest),
+        "Watch": grpc.unary_stream_rpc_method_handler(
+            svc.Watch,
+            request_deserializer=ktpu_pb2.WatchRequest.FromString,
+            response_serializer=ktpu_pb2.WatchEvent.SerializeToString),
+    }
+    return grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
+
+
+class GRPCAPIServer:
+    """Serve one MVCCStore over gRPC (the §5.8 wire option)."""
+
+    def __init__(self, store: MVCCStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = store
+        self.host = host
+        self.port = port
+        self._server: grpc.aio.Server | None = None
+
+    @property
+    def target(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (_handlers(StoreService(self.store)),))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.2)
+            self._server = None
+
+
+class _ListResult:
+    __slots__ = ("items", "resource_version")
+
+    def __init__(self, items, rv):
+        self.items = items
+        self.resource_version = rv
+
+
+class _Event:
+    __slots__ = ("type", "object")
+
+    def __init__(self, type_, obj):
+        self.type = type_
+        self.object = obj
+
+
+def _map_rpc_error(e: grpc.aio.AioRpcError) -> StoreError:
+    cls = _ERR_OF.get(e.code(), StoreError)
+    return cls(e.details() or str(e.code()))
+
+
+class GRPCRemoteStore:
+    """MVCCStore-shaped client over the gRPC wire."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self._channel = grpc.aio.insecure_channel(target)
+
+    def _uu(self, method: str, req, resp_cls=ktpu_pb2.Unknown):
+        return self._channel.unary_unary(
+            f"/{_SERVICE}/{method}",
+            request_serializer=type(req).SerializeToString,
+            response_deserializer=resp_cls.FromString)(req)
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def get(self, resource: str, key: str) -> dict:
+        try:
+            return _unwrap(await self._uu(
+                "Get", ktpu_pb2.GetRequest(resource=resource, key=key)))
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+
+    async def list(self, resource: str, namespace: str | None = None,
+                   selector: Selector | None = None, limit: int = 0,
+                   continue_key: str | None = None) -> _ListResult:
+        sel = selector_to_string(selector) if selector else ""
+        try:
+            resp = await self._uu(
+                "List",
+                ktpu_pb2.ListRequest(
+                    resource=resource, namespace=namespace or "",
+                    label_selector=sel or "", limit=limit,
+                    continue_key=continue_key or ""),
+                ktpu_pb2.ListResponse)
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+        return _ListResult([_unwrap(u) for u in resp.items],
+                           int(resp.resource_version))
+
+    async def create(self, resource: str, obj: dict, **_kw) -> dict:
+        try:
+            return _unwrap(await self._uu("Create", ktpu_pb2.CreateRequest(
+                resource=resource, object=_wrap(dict(obj)))))
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+
+    async def update(self, resource: str, obj: dict, **_kw) -> dict:
+        try:
+            return _unwrap(await self._uu("Update", ktpu_pb2.UpdateRequest(
+                resource=resource, object=_wrap(dict(obj)))))
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+
+    async def delete(self, resource: str, key: str,
+                     uid: str | None = None) -> dict:
+        try:
+            return _unwrap(await self._uu("Delete", ktpu_pb2.DeleteRequest(
+                resource=resource, key=key, uid=uid or "")))
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+
+    async def subresource(self, resource: str, key: str, sub: str,
+                          body: dict) -> dict:
+        try:
+            return _unwrap(await self._uu(
+                "Subresource", ktpu_pb2.SubresourceRequest(
+                    resource=resource, key=key, subresource=sub,
+                    body=_wrap(dict(body)))))
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+
+    async def guaranteed_update(self, resource: str, key: str, mutate,
+                                max_retries: int = 16,
+                                return_copy: bool = True) -> dict | None:
+        """Client-side CAS loop, like the HTTP RemoteStore."""
+        for _ in range(max_retries):
+            current = await self.get(resource, key)
+            updated = mutate(current)
+            if updated is None:
+                if not return_copy:
+                    return None
+                return await self.get(resource, key)
+            try:
+                out = await self.update(resource, updated)
+                return out if return_copy else None
+            except Conflict:
+                continue
+        raise Conflict(f"{resource} {key!r}: too many conflicts")
+
+    async def watch(self, resource: str, resource_version: int | None = None,
+                    selector: Selector | None = None):
+        """Async iterator of events; Expired raised on 410-equivalents so
+        the informer relists, matching the store contract."""
+        sel = selector_to_string(selector) if selector else ""
+        call = self._channel.unary_stream(
+            f"/{_SERVICE}/Watch",
+            request_serializer=ktpu_pb2.WatchRequest.SerializeToString,
+            response_deserializer=ktpu_pb2.WatchEvent.FromString,
+        )(ktpu_pb2.WatchRequest(
+            resource=resource,
+            resource_version=str(resource_version)
+            if resource_version is not None else "",
+            label_selector=sel or ""))
+
+        async def gen():
+            try:
+                async for ev in call:
+                    yield _Event(ev.type, _unwrap(ev.object))
+            except grpc.aio.AioRpcError as e:
+                raise _map_rpc_error(e) from e
+            except asyncio.CancelledError:
+                call.cancel()
+                raise
+        return gen()

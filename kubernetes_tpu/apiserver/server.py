@@ -388,6 +388,11 @@ class APIServer:
                     uid = (body.get("preconditions") or {}).get("uid")
                 except (ValueError, json.JSONDecodeError):
                     pass
+            if self.admission is not None:
+                # Webhooks see the object being deleted (patches have no
+                # meaning on delete; deny aborts it).
+                current = await self.store.get(resource, key)
+                await self.admission.admit(current, resource, "delete")
             return web.json_response(
                 await self.store.delete(resource, key, uid=uid))
         raise web.HTTPMethodNotAllowed(

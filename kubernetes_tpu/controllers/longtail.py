@@ -268,11 +268,7 @@ def install_quota_admission(store) -> None:
                   if q.get("metadata", {}).get("namespace") == ns]
         if not quotas:
             return
-        used = _quota_usage(list(store._table("pods").values()), ns)
         reqs = pod_requests(pod)
-        # On update the old pod is still in the table: credit its usage
-        # back, or a replace could double-count (and quota would be
-        # bypassable by raising requests via PUT).
         key = f"{ns}/{pod.get('metadata', {}).get('name', '')}"
         old = store._table("pods").get(key)
         delta_pods = 1
@@ -282,9 +278,18 @@ def install_quota_admission(store) -> None:
             old_reqs = pod_requests(old)
             old_cpu = old_reqs.get("cpu", 0)
             old_mem = old_reqs.get("memory", 0)
+        d_cpu = reqs.get("cpu", 0) - old_cpu
+        d_mem = reqs.get("memory", 0) - old_mem
+        # Quota only gates usage-INCREASING writes (the reference):
+        # bindings, status flips and request-lowering updates pass without
+        # even scanning the table — an over-quota namespace must not wedge
+        # pod lifecycle, and this is the store's hottest write path.
+        if delta_pods <= 0 and d_cpu <= 0 and d_mem <= 0:
+            return
+        used = _quota_usage(list(store._table("pods").values()), ns)
         want = {"pods": used["pods"] + delta_pods,
-                "cpu": used["cpu"] - old_cpu + reqs.get("cpu", 0),
-                "memory": used["memory"] - old_mem + reqs.get("memory", 0)}
+                "cpu": used["cpu"] + d_cpu,
+                "memory": used["memory"] + d_mem}
         from kubernetes_tpu.store.mvcc import Invalid
         for q in quotas:
             for k, limit in (q.get("spec", {}).get("hard") or {}).items():
