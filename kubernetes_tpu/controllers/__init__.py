@@ -27,6 +27,10 @@ from kubernetes_tpu.controllers.longtail import (
     make_resource_quota,
     make_service,
 )
+from kubernetes_tpu.controllers.kubeproxy import (
+    KubeProxyController,
+    install_service_ip_allocator,
+)
 from kubernetes_tpu.controllers.kwok import KwokController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
@@ -41,6 +45,8 @@ from kubernetes_tpu.controllers.statefulset import (
 )
 
 __all__ = [
+    "KubeProxyController",
+    "install_service_ip_allocator",
     "DisruptionController",
     "EndpointSliceController",
     "HorizontalPodAutoscalerController",

@@ -261,3 +261,53 @@ class TestHPA:
             assert await wait_for(scaled)
             await teardown()
         run(body())
+
+
+class TestKubeProxy:
+    def test_vip_rules_follow_endpoints(self):
+        """Service gets a clusterIP at admission; the proxier compiles
+        (VIP, port) -> ready backends and re-compiles on endpoint churn;
+        lookup round-robins like the kernel DNAT would."""
+        async def body():
+            from kubernetes_tpu.controllers import (
+                KubeProxyController,
+                install_service_ip_allocator,
+            )
+            store, teardown = await stack([], kwok=True, scheduler=True)
+            install_service_ip_allocator(store)
+            eps_ctrl = EndpointSliceController(store)
+            proxy = KubeProxyController(store, min_sync_period=0.01)
+            from kubernetes_tpu.controllers import ControllerManager
+            mgr2 = ControllerManager(store, [eps_ctrl, proxy])
+            await mgr2.start()
+
+            svc = await store.create("services", make_service(
+                "web", {"app": "web"}, port=80))
+            vip = svc["spec"]["clusterIP"]
+            assert vip.startswith("10.96.")
+            for i in range(2):
+                await store.create("pods", make_pod(
+                    f"w{i}", labels={"app": "web"},
+                    requests={"cpu": "100m"}))
+
+            async def two_backends():
+                return len(proxy.rules.get((vip, 80)) or []) == 2
+            assert await wait_for(two_backends)
+            # Round-robin across both backends.
+            seen = {proxy.lookup(vip, 80) for _ in range(4)}
+            assert len(seen) == 2
+            # Endpoint churn recompiles: delete one pod.
+            await store.delete("pods", "default/w0")
+
+            async def one_backend():
+                return len(proxy.rules.get((vip, 80)) or []) == 1
+            assert await wait_for(one_backend)
+            # Service deletion drops the VIP rules entirely.
+            await store.delete("services", "default/web")
+
+            async def gone():
+                return (vip, 80) not in proxy.rules
+            assert await wait_for(gone)
+            await mgr2.stop()
+            await teardown()
+        run(body())
