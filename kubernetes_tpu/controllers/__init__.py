@@ -31,6 +31,10 @@ from kubernetes_tpu.controllers.kubeproxy import (
     KubeProxyController,
     install_service_ip_allocator,
 )
+from kubernetes_tpu.controllers.nodeagent import (
+    NodePressureEvictionController,
+    ProberController,
+)
 from kubernetes_tpu.controllers.kwok import KwokController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
@@ -45,6 +49,8 @@ from kubernetes_tpu.controllers.statefulset import (
 )
 
 __all__ = [
+    "NodePressureEvictionController",
+    "ProberController",
     "KubeProxyController",
     "install_service_ip_allocator",
     "DisruptionController",

@@ -139,6 +139,14 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
 
     Returns (assign (P,), used_pack', fit0 (P,N), taint_ok (P,N)).
     """
+    # Wire decompression (see _prep_chunk): masks arrive bit-packed
+    # uint8 (P, N/8) big-endian, scores float16 — unpack/cast on device
+    # where the FLOPs are free and the relay bytes are not.
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    static_mask = ((static_mask[:, :, None] >> shifts) & 1).reshape(
+        static_mask.shape[0], -1).astype(jnp.bool_)[:, : alloc_q.shape[0]]
+    host_scores = host_scores.astype(jnp.float32)
+
     r = alloc_q.shape[1]
     tf = taint_f_mat.shape[1]
     used_q = used_pack[:, :r]
@@ -1025,21 +1033,25 @@ class TPUBackend:
                 scores_modified = True
 
         # Reuse device-resident constants when untouched (remote-TPU upload
-        # bandwidth is the bottleneck at 5k nodes).
+        # bandwidth is the bottleneck at 5k nodes). Dirty uploads are
+        # compressed for the relay: masks bit-packed (8×: a (2048×5120)
+        # bool mask is 10.5 MB raw, 1.3 MB packed — at ~12 MB/s the raw
+        # form alone throttled the affinity/spread families), scores sent
+        # float16 (2×; unpacked/cast on device in the fused program).
         if mask_modified:
-            dev_mask = self._put(static_mask, "pn")
+            dev_mask = self._put(np.packbits(static_mask, axis=1), "pn")
         else:
             dev_mask = self._dev_base_mask.get(base_key)
             if dev_mask is None:
                 dev_mask = self._dev_base_mask[base_key] = \
-                    self._put(static_mask, "pn")
+                    self._put(np.packbits(static_mask, axis=1), "pn")
         if scores_modified:
-            dev_scores = self._put(host_scores, "pn")
+            dev_scores = self._put(host_scores.astype(np.float16), "pn")
         else:
             dev_scores = self._dev_zero_scores.get((P, N))
             if dev_scores is None:
                 dev_scores = self._dev_zero_scores[(P, N)] = \
-                    self._put(host_scores, "pn")
+                    self._put(host_scores.astype(np.float16), "pn")
 
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are

@@ -411,6 +411,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("config", help="workload YAML")
     ap.add_argument("--backend", choices=["host", "tpu"], default="host")
     ap.add_argument("--batch-size", type=int, default=1)
+    ap.add_argument("--chunk", type=int, default=1024,
+                    help="backend solve chunk (jit batch signature); "
+                         "dirty-mask/score families favor smaller chunks")
     ap.add_argument("--filter", default=None)
     args = ap.parse_args(argv)
 
@@ -418,8 +421,9 @@ def main(argv: list[str] | None = None) -> int:
     batch = args.batch_size
     if args.backend == "tpu":
         from kubernetes_tpu.ops import TPUBackend
-        factory = lambda: TPUBackend(max_batch=max(batch, 2))  # noqa: E731
         batch = max(batch, 128)
+        chunk = max(min(args.chunk, batch), 2)
+        factory = lambda: TPUBackend(max_batch=chunk)  # noqa: E731
     results = run_suite(load_config(args.config), backend_factory=factory,
                         batch_size=batch, filter_name=args.filter)
     print(json.dumps(results, indent=2))

@@ -311,3 +311,103 @@ class TestKubeProxy:
             await mgr2.stop()
             await teardown()
         run(body())
+
+
+class TestNodeAgent:
+    def test_readiness_failure_drops_endpoint(self):
+        """A staged readiness failure flips the Ready condition; the
+        EndpointSlice marks the endpoint not-ready and the proxier drops
+        it from rotation — the full probe → rotation chain."""
+        async def body():
+            from kubernetes_tpu.controllers import (
+                EndpointSliceController,
+                ProberController,
+                install_service_ip_allocator,
+            )
+            store, teardown = await stack(
+                [EndpointSliceController, ProberController],
+                kwok=True, scheduler=True)
+            install_service_ip_allocator(store)
+            svc = await store.create("services", make_service(
+                "web", {"app": "web"}))
+            pod = make_pod("w0", labels={"app": "web"},
+                           requests={"cpu": "100m"})
+            pod["metadata"]["annotations"] = {
+                "kwok.x-k8s.io/fail-readiness-after": "0.2"}
+            await store.create("pods", pod)
+
+            async def not_ready():
+                try:
+                    eps = await store.get("endpointslices", "default/web")
+                except Exception:
+                    return False
+                endpoints = eps.get("endpoints") or []
+                return len(endpoints) == 1 and \
+                    not endpoints[0]["conditions"]["ready"]
+            assert await wait_for(not_ready, timeout=10.0)
+            await teardown()
+        run(body())
+
+    def test_liveness_failure_restarts(self):
+        async def body():
+            from kubernetes_tpu.controllers import ProberController
+            store, teardown = await stack(
+                [ProberController], kwok=True, scheduler=True)
+            pod = make_pod("crashy", requests={"cpu": "100m"})
+            pod["metadata"]["annotations"] = {
+                "kwok.x-k8s.io/fail-liveness-after": "0.2"}
+            await store.create("pods", pod)
+
+            async def restarted():
+                p = await store.get("pods", "default/crashy")
+                return (p.get("status") or {}).get("restartCount", 0) >= 1
+            assert await wait_for(restarted, timeout=10.0)
+            p = await store.get("pods", "default/crashy")
+            ready = next(c for c in p["status"]["conditions"]
+                         if c["type"] == "Ready")
+            assert ready["status"] == "True"  # restarted, back Ready
+            await teardown()
+        run(body())
+
+    def test_node_pressure_evicts_lowest_priority(self):
+        async def body():
+            from kubernetes_tpu.controllers import (
+                NodePressureEvictionController,
+            )
+            store, teardown = await stack([])
+            # Single 8Gi node; threshold 0.9 → pressure above ~7.2Gi.
+            await store.delete("nodes", "n1")
+            await store.delete("nodes", "n2")
+            mgr_node = await store.get("nodes", "n0")
+            mgr_node["status"]["allocatable"]["memory"] = "8Gi"
+            await store.update("nodes", mgr_node)
+            from kubernetes_tpu.controllers import ControllerManager
+            ctrl = NodePressureEvictionController(store, threshold=0.9)
+            mgr2 = ControllerManager(store, [ctrl])
+            await mgr2.start()
+            # 4Gi high-prio + 4Gi low-prio = 8Gi > 7.2Gi threshold.
+            await store.create("pods", make_pod(
+                "hi", node_name="n0", priority=100,
+                requests={"memory": "4Gi"}, phase="Running"))
+            await store.create("pods", make_pod(
+                "lo", node_name="n0", priority=0,
+                requests={"memory": "4Gi"}, phase="Running"))
+
+            async def evicted():
+                pods = {p["metadata"]["name"]
+                        for p in (await store.list("pods")).items}
+                return pods == {"hi"}  # lowest priority went first
+            assert await wait_for(evicted, timeout=10.0)
+
+            # The memory-pressure taint is transient (applied while over
+            # threshold, lifted once eviction clears it) — assert the
+            # durable end state: pressure gone, taint gone.
+            async def untainted():
+                node = await store.get("nodes", "n0")
+                return not any(
+                    t.get("key") == "node.kubernetes.io/memory-pressure"
+                    for t in node.get("spec", {}).get("taints") or [])
+            assert await wait_for(untainted, timeout=10.0)
+            await mgr2.stop()
+            await teardown()
+        run(body())
