@@ -177,6 +177,7 @@ class APIServer:
         self.metrics_registry = metrics_registry
         self.audit_log = audit_log
         self._runner: web.AppRunner | None = None
+        self._proxy_session = None  # shared aggregator proxy client
         self.app = self._build_app()
 
     # -- handler chain (DefaultBuildHandlerChain order) --------------------
@@ -193,6 +194,12 @@ class APIServer:
         app.router.add_get("/healthz", self._healthz)
         app.router.add_get("/readyz", self._healthz)
         app.router.add_get("/metrics", self._metrics)
+        # Discovery + OpenAPI (kubectl's first requests).
+        app.router.add_get("/api", self._discovery_core)
+        app.router.add_get("/apis", self._discovery_groups)
+        app.router.add_get("/api/{version}", self._resource_list)
+        app.router.add_get("/apis/{group}/{version}", self._resource_list)
+        app.router.add_get("/openapi/v2", self._openapi)
         for prefix in ("/api/{version}", "/apis/{group}/{version}"):
             # Namespaced routes first: "/api/v1/namespaces/ns/pods" must not
             # be captured by the generic "{resource}/{name}/{subresource}".
@@ -316,6 +323,129 @@ class APIServer:
     async def _healthz(self, request: web.Request) -> web.Response:
         return web.Response(text="ok")
 
+    # -- discovery + OpenAPI (kubectl bootstrap; kube-aggregator shape) ----
+
+    async def _discovery_core(self, request: web.Request) -> web.Response:
+        return web.json_response({"kind": "APIVersions", "versions": ["v1"]})
+
+    async def _discovery_groups(self, request: web.Request) -> web.Response:
+        """APIGroupList: built-in groups plus aggregated APIServices."""
+        groups = {"apps", "batch", "storage.k8s.io", "scheduling.x-k8s.io",
+                  "topology.node.k8s.io", "autoscaling", "policy",
+                  "rbac.authorization.k8s.io", "apiextensions.k8s.io"}
+        for svc in self.store._table("apiservices").values():
+            g = (svc.get("spec") or {}).get("group")
+            if g:
+                groups.add(g)
+        return web.json_response({
+            "kind": "APIGroupList",
+            "groups": [{"name": g, "versions": [{"version": "v1"}]}
+                       for g in sorted(groups)]})
+
+    async def _resource_list(self, request: web.Request) -> web.Response:
+        """APIResourceList — kubectl's kind↔resource mapping request
+        (GET /apis/apps/v1 etc.). Serves the full known set per group
+        version; aggregated groups proxy."""
+        proxied = await self._maybe_proxy(request)
+        if proxied is not None:
+            return proxied
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        gv = request.match_info.get("version", "v1")
+        group = request.match_info.get("group", "")
+        return web.json_response({
+            "kind": "APIResourceList",
+            "groupVersion": f"{group}/{gv}" if group else gv,
+            "resources": [
+                {"name": resource, "kind": kind,
+                 "namespaced": resource not in CLUSTER_SCOPED,
+                 "verbs": ["get", "list", "watch", "create", "update",
+                           "delete"]}
+                for kind, resource in sorted(KIND_TO_RESOURCE.items())],
+        })
+
+    async def _openapi(self, request: web.Request) -> web.Response:
+        """Minimal swagger 2.0: one path pair per known resource."""
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        paths = {}
+        for kind, resource in sorted(KIND_TO_RESOURCE.items()):
+            base = f"/api/v1/{resource}" if resource in CLUSTER_SCOPED \
+                else f"/api/v1/namespaces/{{namespace}}/{resource}"
+            paths[base] = {"get": {"operationId": f"list{kind}"},
+                           "post": {"operationId": f"create{kind}"}}
+            paths[base + "/{name}"] = {
+                "get": {"operationId": f"read{kind}"},
+                "put": {"operationId": f"replace{kind}"},
+                "delete": {"operationId": f"delete{kind}"}}
+        return web.json_response({
+            "swagger": "2.0",
+            "info": {"title": "kubernetes-tpu", "version": "v1"},
+            "paths": paths})
+
+    def _aggregated_target(self, group: str) -> str | None:
+        """kube-aggregator handler_proxy: an APIService object with
+        spec.group == <group> routes the whole /apis/<group>/... subtree
+        to its extension server."""
+        for svc in self.store._table("apiservices").values():
+            spec = svc.get("spec") or {}
+            if spec.get("group") == group and \
+                    (spec.get("service") or {}).get("url"):
+                return spec["service"]["url"].rstrip("/")
+        return None
+
+    _HOP_HEADERS = {"host", "connection", "keep-alive", "transfer-encoding",
+                    "upgrade", "proxy-authorization", "te", "trailers"}
+
+    def _proxy_client(self):
+        import aiohttp
+        if self._proxy_session is None:
+            # Bounded total timeout: a blackholed extension server must not
+            # pin APF workload seats for aiohttp's 5-minute default (the
+            # WebhookAdmission session pattern). Watches override per-call.
+            self._proxy_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30.0))
+        return self._proxy_session
+
+    async def _maybe_proxy(self,
+                           request: web.Request) -> web.StreamResponse | None:
+        group = request.match_info.get("group")
+        if not group:
+            return None
+        target = self._aggregated_target(group)
+        if target is None:
+            return None
+        import aiohttp
+        url = target + request.path_qs
+        body = await request.read() if request.can_read_body else None
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in self._HOP_HEADERS}
+        is_watch = bool(request.query.get("watch"))
+        try:
+            session = self._proxy_client()
+            kwargs = {}
+            if is_watch:
+                # Long-lived stream: no total deadline, just connect.
+                kwargs["timeout"] = aiohttp.ClientTimeout(
+                    total=None, sock_connect=5.0)
+            async with session.request(request.method, url, data=body,
+                                       headers=headers, **kwargs) as r:
+                if is_watch:
+                    # Stream the chunked watch frames through.
+                    resp = web.StreamResponse(status=r.status)
+                    resp.content_type = r.content_type
+                    await resp.prepare(request)
+                    async for chunk in r.content.iter_any():
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    return resp
+                return web.Response(
+                    status=r.status, body=await r.read(),
+                    content_type=r.content_type or "application/json")
+        except aiohttp.ClientError as e:
+            return web.json_response(_status_body(
+                503, "ServiceUnavailable",
+                f"aggregated apiserver for {group!r} unreachable: {e}"),
+                status=503)
+
     async def _metrics(self, request: web.Request) -> web.Response:
         text = ""
         if self.metrics_registry is not None:
@@ -328,6 +458,9 @@ class APIServer:
         return f"{ns}/{name}" if ns else name
 
     async def _collection(self, request: web.Request) -> web.StreamResponse:
+        proxied = await self._maybe_proxy(request)
+        if proxied is not None:
+            return proxied
         resource = request["resource"]
         if request.method == "GET":
             if request.query.get("watch"):
@@ -366,6 +499,9 @@ class APIServer:
         raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST"])
 
     async def _item(self, request: web.Request) -> web.Response:
+        proxied = await self._maybe_proxy(request)
+        if proxied is not None:
+            return proxied
         resource, key = request["resource"], self._key(request)
         if request.method == "GET":
             return web.json_response(await self.store.get(resource, key))
@@ -399,6 +535,9 @@ class APIServer:
             request.method, ["GET", "PUT", "DELETE"])
 
     async def _sub(self, request: web.Request) -> web.Response:
+        proxied = await self._maybe_proxy(request)
+        if proxied is not None:
+            return proxied
         resource, key = request["resource"], self._key(request)
         sub = request.match_info["subresource"]
         if sub == "status" and request.method == "PUT":
@@ -484,6 +623,9 @@ class APIServer:
         return f"http://{self.host}:{self.port}"
 
     async def stop(self) -> None:
+        if self._proxy_session is not None:
+            await self._proxy_session.close()
+            self._proxy_session = None
         if self.admission is not None:
             await self.admission.close()
         if self._runner is not None:

@@ -345,3 +345,95 @@ class TestSchedulerOverSocket:
             await srv.stop()
             store.stop()
         run(body())
+
+
+class TestDiscoveryAndAggregation:
+    def test_discovery_and_openapi(self):
+        async def body():
+            store, srv = await _serve()
+            rs = RemoteStore(srv.url)
+            import aiohttp
+            async with aiohttp.ClientSession() as s:
+                async with s.get(srv.url + "/api") as r:
+                    assert (await r.json())["versions"] == ["v1"]
+                async with s.get(srv.url + "/apis") as r:
+                    groups = {g["name"]
+                              for g in (await r.json())["groups"]}
+                    assert "apps" in groups and "batch" in groups
+                async with s.get(srv.url + "/openapi/v2") as r:
+                    doc = await r.json()
+                    assert doc["swagger"] == "2.0"
+                    assert "/api/v1/namespaces/{namespace}/pods" in \
+                        doc["paths"]
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_apiservice_routes_group_to_extension_server(self):
+        """kube-aggregator: an APIService proxies /apis/<group>/... to the
+        extension apiserver (handler_proxy.go)."""
+        async def body():
+            from aiohttp import web as aioweb
+            hits = []
+
+            async def extension(request):
+                hits.append(request.path)
+                return aioweb.json_response(
+                    {"kind": "WidgetList", "items": [{"name": "w1"}]})
+
+            ext_app = aioweb.Application()
+            ext_app.router.add_route(
+                "*", "/apis/metrics.ktpu.dev/{tail:.*}", extension)
+            runner = aioweb.AppRunner(ext_app)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            ext_port = site._server.sockets[0].getsockname()[1]
+
+            store, srv = await _serve()
+            await store.create("apiservices", {
+                "kind": "APIService",
+                "metadata": {"name": "v1.metrics.ktpu.dev"},
+                "spec": {"group": "metrics.ktpu.dev", "version": "v1",
+                         "service": {
+                             "url": f"http://127.0.0.1:{ext_port}"}}})
+            import aiohttp
+            async with aiohttp.ClientSession() as s:
+                url = srv.url + "/apis/metrics.ktpu.dev/v1/namespaces/" \
+                    "default/widgets"
+                async with s.get(url) as r:
+                    assert r.status == 200
+                    body_json = await r.json()
+                    assert body_json["kind"] == "WidgetList"
+            assert hits  # the extension server actually served it
+            # Non-aggregated groups still serve locally.
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        srv.url + "/apis/apps/v1/namespaces/default/"
+                        "deployments") as r:
+                    assert r.status == 200
+                    assert (await r.json())["kind"] == "List"
+            await srv.stop()
+            await runner.cleanup()
+            store.stop()
+        run(body())
+
+    def test_resource_list_discovery(self):
+        async def body():
+            store, srv = await _serve()
+            import aiohttp
+            async with aiohttp.ClientSession() as s:
+                async with s.get(srv.url + "/apis/apps/v1") as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert doc["kind"] == "APIResourceList"
+                    by_name = {x["name"]: x for x in doc["resources"]}
+                    assert by_name["deployments"]["kind"] == "Deployment"
+                    assert by_name["deployments"]["namespaced"] is True
+                    assert by_name["nodes"]["namespaced"] is False
+                async with s.get(srv.url + "/api/v1") as r:
+                    assert r.status == 200
+            await srv.stop()
+            store.stop()
+        run(body())
