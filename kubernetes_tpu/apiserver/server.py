@@ -273,8 +273,10 @@ class APIServer:
 
     @web.middleware
     async def _mw_authz(self, request: web.Request, handler):
-        if self.authorizer is None or \
-                request.path in ("/healthz", "/readyz", "/metrics"):
+        # Non-resource paths (health, metrics, discovery, openapi) are
+        # exempt — the reference grants them via system:discovery
+        # nonResourceURLs; RBAC rules here are verb × resource only.
+        if self.authorizer is None or not request.get("resource"):
             return await handler(request)
         user = request.get("user", "system:anonymous")
         verb = request.get("verb", "")
@@ -419,6 +421,7 @@ class APIServer:
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in self._HOP_HEADERS}
         is_watch = bool(request.query.get("watch"))
+        resp = None
         try:
             session = self._proxy_client()
             kwargs = {}
@@ -440,7 +443,18 @@ class APIServer:
                 return web.Response(
                     status=r.status, body=await r.read(),
                     content_type=r.content_type or "application/json")
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # TimeoutError is what the total ClientTimeout raises — it is
+            # NOT a ClientError subclass.
+            if resp is not None and resp.prepared:
+                # Headers already sent (extension died mid-watch): end the
+                # stream cleanly; a second response body would corrupt the
+                # connection.
+                try:
+                    await resp.write_eof()
+                except (ConnectionError, RuntimeError):
+                    pass
+                return resp
             return web.json_response(_status_body(
                 503, "ServiceUnavailable",
                 f"aggregated apiserver for {group!r} unreachable: {e}"),

@@ -464,8 +464,18 @@ class Scheduler:
                 self.metrics.observe_attempt("unschedulable", fwk.profile_name,
                                              elapsed / len(pods))
                 statuses = diagnostics.get(pi.key, {})
+                # state+snapshot enable the PostFilter (preemption) branch
+                # — without them the batched path could never preempt.
+                # PreFilter runs first so the dry-run's filters see the
+                # pod's affinity/spread/volume prefilter state (an empty
+                # CycleState would make those filters vacuously pass and
+                # evict victims on nodes the pod can never land on).
+                live = self.cache.update_snapshot()
+                state = CycleState()
+                fwk.run_pre_filter(state, pi, live)
                 await self._handle_failure(
-                    fwk, pi, FitError(pi, len(snapshot), statuses), statuses)
+                    fwk, pi, FitError(pi, len(snapshot), statuses),
+                    statuses, state=state, snapshot=live)
 
     async def _schedule_host_path(self, pi: PodInfo, snapshot) -> None:
         fwk = self.profiles.get(pi.scheduler_name)

@@ -145,3 +145,23 @@ class TestNewFamilies:
             template, {}, timeout=60.0))
         assert res.scheduled_total == 20
         assert res.unschedulable_total == 0
+
+    def test_preemption_family_on_tpu_backend(self):
+        """Regression: the batched backend path must trigger PostFilter
+        preemption (it once dropped state/snapshot from _handle_failure,
+        so batch-scheduled clusters could never preempt)."""
+        from kubernetes_tpu.ops import TPUBackend
+        template = [
+            {"opcode": "createNodes", "count": 4,
+             "nodeTemplate": {"allocatable":
+                              {"cpu": "2", "memory": "8Gi", "pods": "16"}}},
+            {"opcode": "createPods", "count": 8,
+             "podTemplate": {"priority": 0, "requests": {"cpu": "1"}}},
+            {"opcode": "barrier"},
+            {"opcode": "createPods", "count": 4, "collectMetrics": True,
+             "podTemplate": {"priority": 100, "requests": {"cpu": "1"}}},
+        ]
+        res = asyncio.run(PerfRunner(
+            backend=TPUBackend(max_batch=8), batch_size=8).run(
+            template, {}, timeout=60.0))
+        assert res.measured_pods == 4
