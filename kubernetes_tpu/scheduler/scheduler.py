@@ -387,8 +387,21 @@ class Scheduler:
             # plugin set/weights (profiles are keyed by schedulerName), and
             # the TPUScorer gate selects the backend PER PROFILE
             # (backend_profiles; None = all).
+            # Preemptor retries ride the host path's nominated-node fast
+            # path FIRST, across every profile (schedule_one.go evaluates
+            # the nominee before anything else): the batch solve has no
+            # nominee bias, so any batch processed earlier could steal the
+            # freed node and force a re-preemption — eviction churn.
+            nominated = [pi for pi in pods if pi.nominated_node]
+            if nominated:
+                for pi in nominated:
+                    await self._schedule_host_path(pi, snapshot)
+                    snapshot = self.cache.update_snapshot()
+                tr.step(f"nominated fast path ({len(nominated)} pods)")
             by_profile: dict[str, list[PodInfo]] = {}
             for pi in pods:
+                if pi.nominated_node:
+                    continue
                 by_profile.setdefault(pi.scheduler_name, []).append(pi)
             # The backend chunks to its own batch capacity internally and
             # PIPELINES the chunks (device state chains on device; chunk

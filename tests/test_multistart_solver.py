@@ -179,3 +179,61 @@ class TestPriorityFairness:
         # Without block stability, [3,3] (volume 6) would beat [2,3]
         # (volume 5) and starve the high-priority pod.
         assert assign["default/hi"] is not None
+
+
+class TestNominatedFastPath:
+    def test_preemptor_lands_on_nominated_node_via_batch_path(self):
+        """A preemptor retrying with status.nominatedNodeName must take the
+        host fast path ahead of the batch solve (no nominee bias there) and
+        land exactly once, on its nominee."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(2):
+                await store.create("nodes", make_node(f"n{i}", allocatable={
+                    "cpu": "2", "memory": "8Gi", "pods": "16"}))
+            backend = TPUBackend(max_batch=8, multistart=2)
+            sched = Scheduler(store, seed=9, backend=backend)
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            task = asyncio.ensure_future(sched.run(batch_size=8))
+            # Saturate with low-priority fillers.
+            for i in range(4):
+                await store.create("pods", make_pod(
+                    f"filler-{i}", requests={"cpu": "1"}, priority=0))
+
+            async def full():
+                pods = (await store.list("pods")).items
+                return sum(1 for p in pods
+                           if p["spec"].get("nodeName")) == 4
+            for _ in range(200):
+                if await full():
+                    break
+                await asyncio.sleep(0.03)
+            assert await full()
+            # High-priority pod arrives; preemption nominates + evicts.
+            await store.create("pods", make_pod(
+                "vip", requests={"cpu": "1"}, priority=1000))
+
+            async def vip_bound():
+                p = await store.get("pods", "default/vip")
+                return p["spec"].get("nodeName")
+            for _ in range(400):
+                if await vip_bound():
+                    break
+                await asyncio.sleep(0.05)
+            node = await vip_bound()
+            assert node  # scheduled after eviction
+            # Exactly the victims needed were evicted (no churn): 4
+            # fillers - 1 victim = 3 remain.
+            pods = (await store.list("pods")).items
+            fillers = [p for p in pods
+                       if p["metadata"]["name"].startswith("filler")]
+            assert len(fillers) == 3
+            await sched.stop()
+            task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
