@@ -35,7 +35,7 @@ from kubernetes_tpu.store.mvcc import (
     NotFound,
     StoreError,
 )
-from kubernetes_tpu.apiserver.server import CLUSTER_SCOPED
+from kubernetes_tpu.apiserver.server import CLUSTER_SCOPED, PROTOBUF_CT
 
 logger = logging.getLogger(__name__)
 
@@ -67,9 +67,16 @@ class RemoteStore:
     """MVCCStore-shaped client for an APIServer at `base_url`."""
 
     def __init__(self, base_url: str, *, token: str | None = None,
-                 user_agent: str = "kubernetes-tpu-client"):
+                 user_agent: str = "kubernetes-tpu-client",
+                 protobuf: bool = False):
         self.base_url = base_url.rstrip("/")
         self._headers = {"User-Agent": user_agent}
+        #: Negotiate the runtime.Unknown protobuf envelope for single
+        #: objects (the reference's application/vnd.kubernetes.protobuf
+        #: wire between core components); lists/watches stay JSON.
+        self.protobuf = protobuf
+        if protobuf:
+            self._headers["Accept"] = f"{PROTOBUF_CT}, application/json"
         if token:
             self._headers["Authorization"] = f"Bearer {token}"
         self._session: aiohttp.ClientSession | None = None
@@ -103,6 +110,17 @@ class RemoteStore:
         return f"{self.base_url}/api/v1/{resource}/{key}"
 
     async def _json(self, resp: aiohttp.ClientResponse):
+        if resp.content_type == PROTOBUF_CT:
+            # runtime.Unknown envelope (see apiserver/grpc_server._wrap).
+            from kubernetes_tpu.apiserver.grpc_server import (
+                _unwrap,
+                ktpu_pb2,
+            )
+            raw = await resp.read()
+            if resp.status < 400:
+                return _unwrap(ktpu_pb2.Unknown.FromString(raw))
+            body = raw.decode(errors="replace")
+            _raise_for_status(resp.status, body)
         try:
             body = await resp.json()
         except (aiohttp.ContentTypeError, json.JSONDecodeError):

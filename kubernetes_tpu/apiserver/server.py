@@ -43,6 +43,27 @@ logger = logging.getLogger(__name__)
 from kubernetes_tpu.api.meta import CLUSTER_SCOPED_RESOURCES as CLUSTER_SCOPED
 
 
+PROTOBUF_CT = "application/vnd.kubernetes.protobuf"
+
+
+def _wants_protobuf(request: web.Request) -> bool:
+    return PROTOBUF_CT in request.headers.get("Accept", "")
+
+
+def _object_response(request: web.Request, obj: dict,
+                     status: int = 200) -> web.Response:
+    """Content negotiation (§5.8: core components speak protobuf over
+    HTTP): a client accepting application/vnd.kubernetes.protobuf gets
+    the runtime.Unknown envelope (TypeMeta + raw JSON payload bytes —
+    the same wire the gRPC service carries); everyone else gets JSON."""
+    if _wants_protobuf(request):
+        from kubernetes_tpu.apiserver.grpc_server import _wrap
+        return web.Response(status=status,
+                            body=_wrap(obj).SerializeToString(),
+                            content_type=PROTOBUF_CT)
+    return web.json_response(obj, status=status)
+
+
 def _status_body(code: int, reason: str, message: str) -> dict:
     return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
             "reason": reason, "code": code, "message": message}
@@ -509,7 +530,7 @@ class APIServer:
             if self.admission is not None:
                 obj = await self.admission.admit(obj, resource, "create")
             created = await self.store.create(resource, obj)
-            return web.json_response(created, status=201)
+            return _object_response(request, created, status=201)
         raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST"])
 
     async def _item(self, request: web.Request) -> web.Response:
@@ -518,7 +539,8 @@ class APIServer:
             return proxied
         resource, key = request["resource"], self._key(request)
         if request.method == "GET":
-            return web.json_response(await self.store.get(resource, key))
+            return _object_response(
+                request, await self.store.get(resource, key))
         if request.method == "PUT":
             obj = await request.json()
             # The URL fully identifies the object; default the body's
@@ -529,7 +551,8 @@ class APIServer:
                 meta.setdefault("namespace", request["namespace"])
             if self.admission is not None:
                 obj = await self.admission.admit(obj, resource, "update")
-            return web.json_response(await self.store.update(resource, obj))
+            return _object_response(
+                request, await self.store.update(resource, obj))
         if request.method == "DELETE":
             uid = None
             if request.can_read_body:

@@ -437,3 +437,32 @@ class TestDiscoveryAndAggregation:
             await srv.stop()
             store.stop()
         run(body())
+
+
+class TestProtobufContentNegotiation:
+    def test_protobuf_clients_and_json_clients_interop(self):
+        """§5.8: components can speak the protobuf (runtime.Unknown
+        envelope) wire over HTTP while JSON clients share the server."""
+        async def body():
+            store, srv = await _serve()
+            pb = RemoteStore(srv.url, protobuf=True)
+            js = RemoteStore(srv.url)
+            created = await pb.create("pods", make_pod("a"))
+            assert created["metadata"]["name"] == "a"
+            assert created["metadata"]["resourceVersion"]
+            # JSON client reads what the protobuf client wrote.
+            got = await js.get("pods", "default/a")
+            assert got["metadata"]["uid"] == created["metadata"]["uid"]
+            # protobuf client reads + updates.
+            got_pb = await pb.get("pods", "default/a")
+            got_pb["metadata"]["labels"] = {"wire": "proto"}
+            updated = await pb.update("pods", got_pb)
+            assert updated["metadata"]["labels"] == {"wire": "proto"}
+            # Errors still map on the protobuf path.
+            with pytest.raises(NotFound):
+                await pb.get("pods", "default/nope")
+            await pb.close()
+            await js.close()
+            await srv.stop()
+            store.stop()
+        run(body())

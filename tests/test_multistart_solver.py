@@ -213,9 +213,16 @@ class TestNominatedFastPath:
                     break
                 await asyncio.sleep(0.03)
             assert await full()
-            # High-priority pod arrives; preemption nominates + evicts.
+            # High-priority pod arrives WITH low-priority company, so the
+            # post-eviction retry pops a MULTI-pod batch and the nominee
+            # fast path on the batch branch is what actually runs (a
+            # 1-pod retry would take the single-pod host path and this
+            # test would guard nothing).
             await store.create("pods", make_pod(
                 "vip", requests={"cpu": "1"}, priority=1000))
+            for i in range(3):
+                await store.create("pods", make_pod(
+                    f"extra-{i}", requests={"cpu": "1"}, priority=0))
 
             async def vip_bound():
                 p = await store.get("pods", "default/vip")
@@ -227,11 +234,17 @@ class TestNominatedFastPath:
             node = await vip_bound()
             assert node  # scheduled after eviction
             # Exactly the victims needed were evicted (no churn): 4
-            # fillers - 1 victim = 3 remain.
+            # fillers - 1 victim = 3 remain; the low-priority extras stay
+            # pending (no capacity, and they must not have stolen the
+            # vip's freed node).
             pods = (await store.list("pods")).items
             fillers = [p for p in pods
                        if p["metadata"]["name"].startswith("filler")]
             assert len(fillers) == 3
+            extras_bound = [p for p in pods
+                            if p["metadata"]["name"].startswith("extra")
+                            and p["spec"].get("nodeName")]
+            assert extras_bound == []
             await sched.stop()
             task.cancel()
             factory.stop()
