@@ -20,11 +20,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Mapping
 
-from kubernetes_tpu.api.meta import (
-    CLUSTER_SCOPED_RESOURCES,
-    KIND_TO_RESOURCE,
-    name_of,
-)
+from kubernetes_tpu.api.meta import name_of
 from kubernetes_tpu.store.mvcc import Invalid, StoreError
 
 logger = logging.getLogger(__name__)
@@ -268,9 +264,15 @@ def install_crd_support(store) -> None:
         kind = names.get("kind")
         if not plural or not kind:
             raise Invalid("CRD: spec.names.plural and .kind are required")
-        KIND_TO_RESOURCE.setdefault(kind, plural)
+        # Store-local registration: kind mappings must not leak into other
+        # stores in the process, and scope must follow CRD delete/re-create
+        # (deregister below), so the process-global KIND_TO_RESOURCE /
+        # CLUSTER_SCOPED_RESOURCES stay untouched.
+        store.custom_kinds.setdefault(kind, plural)
         if spec.get("scope") == "Cluster":
-            CLUSTER_SCOPED_RESOURCES.add(plural)
+            store.custom_cluster_scoped.add(plural)
+        else:
+            store.custom_cluster_scoped.discard(plural)
         if plural in registered:
             return  # one live-reading validator per plural is enough
         registered.add(plural)
@@ -295,6 +297,23 @@ def install_crd_support(store) -> None:
 
     store.register_mutator("customresourcedefinitions", register,
                            on=("create", "update"))
+
+    def deregister(crd: dict) -> None:
+        names = (crd.get("spec") or {}).get("names") or {}
+        plural, kind = names.get("plural"), names.get("kind")
+        if not plural:
+            return  # malformed CRD (never registered) must stay deletable
+        if store.custom_kinds.get(kind) == plural:
+            del store.custom_kinds[kind]
+        store.custom_cluster_scoped.discard(plural)
+        # `registered` is deliberately NOT cleared: the live-reading
+        # validator self-disables while no CRD exists and re-enables on
+        # re-create; dropping the guard would stack a duplicate validator
+        # per delete/create cycle. Kind/scope entries (above) are written
+        # by register() before its guard, so re-creates still refresh them.
+
+    store.register_mutator("customresourcedefinitions", deregister,
+                           on=("delete",))
 
     # CRDs created before install (store load) register too.
     for crd in list(store._table("customresourcedefinitions").values()):

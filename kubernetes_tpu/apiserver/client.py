@@ -35,7 +35,10 @@ from kubernetes_tpu.store.mvcc import (
     NotFound,
     StoreError,
 )
-from kubernetes_tpu.apiserver.server import CLUSTER_SCOPED, PROTOBUF_CT
+from kubernetes_tpu.api.meta import (
+    CLUSTER_SCOPED_RESOURCES as CLUSTER_SCOPED,
+)
+from kubernetes_tpu.apiserver.server import PROTOBUF_CT
 
 logger = logging.getLogger(__name__)
 
@@ -80,6 +83,12 @@ class RemoteStore:
         if token:
             self._headers["Authorization"] = f"Bearer {token}"
         self._session: aiohttp.ClientSession | None = None
+        # Discovery-learned kind/scope maps (refresh_discovery). CRD
+        # registration is store-local server-side, so a remote client must
+        # LEARN custom scopes from /api/v1 rather than share process
+        # globals; until fetched, built-ins apply.
+        self._disc_kinds: dict[str, str] | None = None
+        self._disc_cluster_scoped: set[str] = set()
 
     def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -95,10 +104,49 @@ class RemoteStore:
         if self._session is not None and not self._session.closed:
             asyncio.ensure_future(self._session.close())
 
+    # -- discovery ---------------------------------------------------------
+
+    async def refresh_discovery(self) -> None:
+        """Fetch /api/v1 (APIResourceList) and cache kind↔resource +
+        scope for every server-known resource, CRDs included — the
+        kubectl RESTMapper pattern. Safe to skip: built-ins then apply."""
+        async with self._sess().get(f"{self.base_url}/api/v1") as r:
+            if r.status != 200:
+                return
+            doc = await r.json()
+        kinds: dict[str, str] = {}
+        scoped: set[str] = set()
+        for res in doc.get("resources") or []:
+            name, kind = res.get("name"), res.get("kind")
+            if not name or not kind:
+                continue
+            kinds[kind] = name
+            if not res.get("namespaced", True):
+                scoped.add(name)
+        self._disc_kinds = kinds
+        self._disc_cluster_scoped = scoped
+
+    def is_cluster_scoped(self, resource: str) -> bool:
+        if self._disc_kinds is not None:
+            return resource in self._disc_cluster_scoped
+        return resource in CLUSTER_SCOPED
+
+    def resource_for_kind(self, kind: str) -> str | None:
+        if self._disc_kinds is not None and kind in self._disc_kinds:
+            return self._disc_kinds[kind]
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        return KIND_TO_RESOURCE.get(kind)
+
+    def kind_map(self) -> dict[str, str]:
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        merged = dict(KIND_TO_RESOURCE)
+        merged.update(self._disc_kinds or {})
+        return merged
+
     # -- URL helpers -------------------------------------------------------
 
     def _collection_url(self, resource: str, namespace: str | None) -> str:
-        if resource in CLUSTER_SCOPED or not namespace:
+        if self.is_cluster_scoped(resource) or not namespace:
             return f"{self.base_url}/api/v1/{resource}"
         return f"{self.base_url}/api/v1/namespaces/{namespace}/{resource}"
 

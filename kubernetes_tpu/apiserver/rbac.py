@@ -43,6 +43,10 @@ class RBACAuthorizer:
         self._rules: dict[str, list[dict]] = {}
         #: user -> set of role names ("*" user = everyone)
         self._grants: dict[str, set[str]] = {}
+        #: group -> set of role names — kept apart from users so a binding
+        #: to Group "admins" never empowers a USER literally named "admins"
+        #: (the reference keys its rule index by subject kind too).
+        self._group_grants: dict[str, set[str]] = {}
         for r in roles:
             self.add_role(r)
         for b in bindings:
@@ -57,12 +61,27 @@ class RBACAuthorizer:
         if not role:
             return
         for subj in binding.get("subjects") or []:
-            if subj.get("kind") in (None, "User", "Group"):
-                self._grants.setdefault(
-                    subj.get("name", ""), set()).add(role)
+            kind = subj.get("kind")
+            name = subj.get("name", "")
+            if kind == "Group":
+                self._group_grants.setdefault(name, set()).add(role)
+            elif kind == "ServiceAccount":
+                # SA subjects authenticate as their token username. No
+                # namespace ⇒ matches nothing (upstream RBAC ignores such
+                # subjects rather than guessing a namespace).
+                ns = subj.get("namespace")
+                if ns:
+                    self._grants.setdefault(
+                        f"system:serviceaccount:{ns}:{name}",
+                        set()).add(role)
+            elif kind in (None, "User"):
+                self._grants.setdefault(name, set()).add(role)
 
-    def allowed(self, user: str, verb: str, resource: str) -> bool:
+    def allowed(self, user: str, verb: str, resource: str,
+                groups: Iterable[str] = ()) -> bool:
         roles = self._grants.get(user, set()) | self._grants.get("*", set())
+        for g in groups:
+            roles = roles | self._group_grants.get(g, set())
         for role in roles:
             for rule in self._rules.get(role, ()):
                 verbs = rule.get("verbs") or ()

@@ -40,7 +40,6 @@ from kubernetes_tpu.store.mvcc import (
 
 logger = logging.getLogger(__name__)
 
-from kubernetes_tpu.api.meta import CLUSTER_SCOPED_RESOURCES as CLUSTER_SCOPED
 
 
 PROTOBUF_CT = "application/vnd.kubernetes.protobuf"
@@ -175,6 +174,7 @@ class APIServer:
                  host: str = "127.0.0.1", port: int = 0,
                  priority_levels: Mapping[str, PriorityLevel] | None = None,
                  bearer_tokens: Mapping[str, str] | None = None,
+                 user_groups: Mapping[str, list[str]] | None = None,
                  authorizer=None,
                  admission=None,
                  metrics_registry=None,
@@ -189,6 +189,11 @@ class APIServer:
             "workload": PriorityLevel("workload", seats=32),
         })
         self.bearer_tokens = dict(bearer_tokens or {})  # token -> username
+        #: username -> group names, the authn side of Group subjects; the
+        #: implicit system:authenticated/unauthenticated groups are added
+        #: per-request (reference: authenticatorfactory + user.Info.Groups).
+        self.user_groups = {u: list(g) for u, g in
+                            (user_groups or {}).items()}
         #: RBACAuthorizer (apiserver/rbac.py) or None = authz disabled
         #: (the reference's AlwaysAllow mode).
         self.authorizer = authorizer
@@ -292,6 +297,16 @@ class APIServer:
         request["user"] = user
         return await handler(request)
 
+    def _groups_for(self, user: str) -> list[str]:
+        """Configured groups + the implicit authn group — the same set for
+        local authz and the aggregator's X-Remote-Group, so group bindings
+        behave identically on both sides of the proxy."""
+        groups = list(self.user_groups.get(user, ()))
+        groups.append("system:unauthenticated"
+                      if user == "system:anonymous"
+                      else "system:authenticated")
+        return groups
+
     @web.middleware
     async def _mw_authz(self, request: web.Request, handler):
         # Non-resource paths (health, metrics, discovery, openapi) are
@@ -302,7 +317,8 @@ class APIServer:
         user = request.get("user", "system:anonymous")
         verb = request.get("verb", "")
         resource = request.get("resource", "")
-        if not self.authorizer.allowed(user, verb, resource):
+        if not self.authorizer.allowed(user, verb, resource,
+                                       groups=self._groups_for(user)):
             return web.json_response(_status_body(
                 403, "Forbidden",
                 f'user "{user}" cannot {verb} resource "{resource}"'),
@@ -372,7 +388,6 @@ class APIServer:
         proxied = await self._maybe_proxy(request)
         if proxied is not None:
             return proxied
-        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
         gv = request.match_info.get("version", "v1")
         group = request.match_info.get("group", "")
         return web.json_response({
@@ -380,19 +395,18 @@ class APIServer:
             "groupVersion": f"{group}/{gv}" if group else gv,
             "resources": [
                 {"name": resource, "kind": kind,
-                 "namespaced": resource not in CLUSTER_SCOPED,
+                 "namespaced": not self.store.is_cluster_scoped(resource),
                  "verbs": ["get", "list", "watch", "create", "update",
                            "delete"]}
-                for kind, resource in sorted(KIND_TO_RESOURCE.items())],
+                for kind, resource in sorted(self.store.kind_map().items())],
         })
 
     async def _openapi(self, request: web.Request) -> web.Response:
         """Minimal swagger 2.0: one path pair per known resource."""
-        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
         paths = {}
-        for kind, resource in sorted(KIND_TO_RESOURCE.items()):
-            base = f"/api/v1/{resource}" if resource in CLUSTER_SCOPED \
-                else f"/api/v1/namespaces/{{namespace}}/{resource}"
+        for kind, resource in sorted(self.store.kind_map().items()):
+            base = f"/api/v1/{resource}" if self.store.is_cluster_scoped(
+                resource) else f"/api/v1/namespaces/{{namespace}}/{resource}"
             paths[base] = {"get": {"operationId": f"list{kind}"},
                            "post": {"operationId": f"create{kind}"}}
             paths[base + "/{name}"] = {
@@ -415,8 +429,24 @@ class APIServer:
                 return spec["service"]["url"].rstrip("/")
         return None
 
+    # Client credentials are stripped, not forwarded: the reference
+    # aggregator authenticates ITSELF to extension servers and passes the
+    # caller's identity via X-Remote-* headers (kube-aggregator
+    # handler_proxy + x509 requestheader authn). Forwarding the bearer
+    # token would hand every client's credential to whoever registers an
+    # APIService.
     _HOP_HEADERS = {"host", "connection", "keep-alive", "transfer-encoding",
-                    "upgrade", "proxy-authorization", "te", "trailers"}
+                    "upgrade", "proxy-authorization", "te", "trailers",
+                    "authorization", "cookie"}
+
+    @classmethod
+    def _forwardable(cls, header: str) -> bool:
+        h = header.lower()
+        # Every client-supplied x-remote-* is dropped (not just user/group):
+        # the extension trusts that namespace as proxy-asserted identity, so
+        # forwarding e.g. X-Remote-Extra-Scopes would let callers inject
+        # attributes onto their verified identity.
+        return h not in cls._HOP_HEADERS and not h.startswith("x-remote-")
 
     def _proxy_client(self):
         import aiohttp
@@ -440,7 +470,11 @@ class APIServer:
         url = target + request.path_qs
         body = await request.read() if request.can_read_body else None
         headers = {k: v for k, v in request.headers.items()
-                   if k.lower() not in self._HOP_HEADERS}
+                   if self._forwardable(k)}
+        ruser = request.get("user", "system:anonymous")
+        headers["X-Remote-User"] = ruser
+        rgroups = self._groups_for(ruser)
+        headers["X-Remote-Group"] = ",".join(rgroups)
         is_watch = bool(request.query.get("watch"))
         resp = None
         try:

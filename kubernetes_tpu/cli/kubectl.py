@@ -21,7 +21,7 @@ import time
 from typing import Any
 
 from kubernetes_tpu.api.meta import (
-    CLUSTER_SCOPED_RESOURCES as CLUSTER_SCOPED,
+    CLUSTER_SCOPED_RESOURCES,
     KIND_TO_RESOURCE,
     namespaced_name,
 )
@@ -40,8 +40,20 @@ def _resource(arg: str) -> str:
     return ALIASES.get(arg, arg)
 
 
-def _key(resource: str, name: str, namespace: str) -> str:
-    if resource in CLUSTER_SCOPED:
+def _cluster_scoped(store, resource: str) -> bool:
+    # In-process stores know their own CRD-registered scopes; remote
+    # clients fall back to the built-in set.
+    f = getattr(store, "is_cluster_scoped", None)
+    return f(resource) if f else resource in CLUSTER_SCOPED_RESOURCES
+
+
+def _kind_map(store) -> dict:
+    f = getattr(store, "kind_map", None)
+    return f() if f else KIND_TO_RESOURCE
+
+
+def _key(store, resource: str, name: str, namespace: str) -> str:
+    if _cluster_scoped(store, resource):
         return name
     return f"{namespace}/{name}"
 
@@ -104,7 +116,7 @@ async def cmd_get(store, args, out) -> int:
     if args.name:
         try:
             obj = await store.get(resource,
-                                  _key(resource, args.name, args.namespace))
+                                  _key(store, resource, args.name, args.namespace))
         except NotFound as e:
             print(f"Error: {e}", file=sys.stderr)
             return 1
@@ -115,7 +127,7 @@ async def cmd_get(store, args, out) -> int:
     else:
         # Namespace filtering happens server-side (store.list supports
         # namespace=), not by transferring the whole cluster and sifting.
-        ns = None if (resource in CLUSTER_SCOPED or args.all_namespaces) \
+        ns = None if (_cluster_scoped(store, resource) or args.all_namespaces) \
             else args.namespace
         sel = None
         if args.selector:
@@ -140,7 +152,7 @@ async def cmd_get(store, args, out) -> int:
 
 async def cmd_describe(store, args, out) -> int:
     resource = _resource(args.resource)
-    key = _key(resource, args.name, args.namespace)
+    key = _key(store, resource, args.name, args.namespace)
     try:
         obj = await store.get(resource, key)
     except NotFound as e:
@@ -152,7 +164,7 @@ async def cmd_describe(store, args, out) -> int:
         events = (await store.list("events")).items
     except StoreError:
         events = []
-    want_kind = {k for k, r in KIND_TO_RESOURCE.items() if r == resource}
+    want_kind = {k for k, r in _kind_map(store).items() if r == resource}
     related = []
     for e in events:
         inv = e.get("involvedObject") or {}
@@ -160,7 +172,7 @@ async def cmd_describe(store, args, out) -> int:
             continue
         if inv.get("kind") and want_kind and inv["kind"] not in want_kind:
             continue
-        if resource not in CLUSTER_SCOPED and \
+        if not _cluster_scoped(store, resource) and \
                 inv.get("namespace", args.namespace) != args.namespace:
             continue
         related.append(e)
@@ -181,15 +193,15 @@ def _load_manifests(path: str) -> list[dict]:
 async def cmd_apply(store, args, out) -> int:
     rc = 0
     for obj in _load_manifests(args.filename):
-        resource = KIND_TO_RESOURCE.get(obj.get("kind", ""))
+        resource = _kind_map(store).get(obj.get("kind", ""))
         if resource is None:
             print(f"Error: unknown kind {obj.get('kind')!r}", file=sys.stderr)
             rc = 1
             continue
         meta = obj.setdefault("metadata", {})
-        if resource not in CLUSTER_SCOPED:
+        if not _cluster_scoped(store, resource):
             meta.setdefault("namespace", args.namespace)
-        key = _key(resource, meta.get("name", ""),
+        key = _key(store, resource, meta.get("name", ""),
                    meta.get("namespace", args.namespace))
         try:
             current = await store.get(resource, key)
@@ -215,14 +227,14 @@ async def cmd_delete(store, args, out) -> int:
     if args.filename:
         rc = 0
         for obj in _load_manifests(args.filename):
-            resource = KIND_TO_RESOURCE.get(obj.get("kind", ""))
+            resource = _kind_map(store).get(obj.get("kind", ""))
             if resource is None:
                 print(f"Error: unknown kind {obj.get('kind')!r}",
                       file=sys.stderr)
                 rc = 1
                 continue
             meta = obj.get("metadata", {})
-            key = _key(resource, meta.get("name", ""),
+            key = _key(store, resource, meta.get("name", ""),
                        meta.get("namespace", args.namespace))
             try:
                 await store.delete(resource, key)
@@ -234,7 +246,7 @@ async def cmd_delete(store, args, out) -> int:
     resource = _resource(args.resource)
     try:
         await store.delete(resource,
-                           _key(resource, args.name, args.namespace))
+                           _key(store, resource, args.name, args.namespace))
     except StoreError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
@@ -244,7 +256,7 @@ async def cmd_delete(store, args, out) -> int:
 
 async def cmd_scale(store, args, out) -> int:
     resource = _resource(args.resource)
-    key = _key(resource, args.name, args.namespace)
+    key = _key(store, resource, args.name, args.namespace)
 
     def mutate(obj):
         if resource == "jobs":
@@ -417,6 +429,12 @@ def main(argv: list[str] | None = None) -> int:
         from kubernetes_tpu.apiserver.client import RemoteStore
         rs = RemoteStore(args.server, token=args.token)
         try:
+            try:
+                # Learn CRD kinds/scopes from server discovery (RESTMapper
+                # pattern); a failed fetch just leaves the built-ins.
+                await rs.refresh_discovery()
+            except Exception:
+                pass
             return await run_command(rs, args)
         finally:
             await rs.close()

@@ -77,6 +77,17 @@ class GarbageCollectorController(Controller):
         #: dependent (resource, key) -> set of owner uids it waits on.
         self._owners_of: dict[tuple[str, str], set[str]] = {}
 
+    def _resource_for(self, kind: str | None) -> str | None:
+        """Owner-kind resolution includes the store's CRD-registered kinds
+        (store-local since ADVICE r3), falling back to the built-ins for
+        remote stores without the accessor."""
+        f = getattr(self.store, "resource_for_kind", None)
+        return f(kind) if f else KIND_TO_RESOURCE.get(kind)
+
+    def _cluster_scoped(self, resource: str) -> bool:
+        f = getattr(self.store, "is_cluster_scoped", None)
+        return f(resource) if f else resource in CLUSTER_SCOPED_RESOURCES
+
     def setup(self, factory: InformerFactory) -> None:
         self._informers = {}
         for resource in self.resources:
@@ -109,20 +120,22 @@ class GarbageCollectorController(Controller):
         # Only owners of WATCHED resources enter the graph: a Node-owned
         # mirror pod (or any unwatched kind) must never be tracked, or the
         # resync sweep would re-enqueue + re-verify it forever.
+        # Accumulate first, write the graph only once every ref is watched:
+        # writing _dependents per-ref and bailing on a later unwatched ref
+        # would leave orphaned entries (map leak) that enqueue spurious
+        # sync work for objects the GC will always keep.
         owners = set()
-        collectable = True
         for ref in refs:
-            owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
+            owner_res = self._resource_for(ref.get("kind"))
             if owner_res is None or owner_res not in self.resources:
-                collectable = False
-                continue
+                return  # any unwatched owner kind ⇒ never collectable
             ouid = ref.get("uid")
-            if not ouid:
-                continue
-            owners.add(ouid)
-            self._dependents.setdefault(ouid, set()).add(dep)
-        if not collectable or not owners:
+            if ouid:
+                owners.add(ouid)
+        if not owners:
             return
+        for ouid in owners:
+            self._dependents.setdefault(ouid, set()).add(dep)
         self._owners_of[dep] = owners
         # Owner already gone (or never seen after sync) → collect now.
         if not any(o in self._alive for o in owners):
@@ -163,13 +176,13 @@ class GarbageCollectorController(Controller):
         # informers, and unwatched owner kinds are NEVER collectable.
         ns = obj.get("metadata", {}).get("namespace", "default")
         for ref in refs:
-            owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
+            owner_res = self._resource_for(ref.get("kind"))
             if owner_res is None or owner_res not in self.resources:
                 # An owner of an UNWATCHED kind (Node, custom resource,
                 # ...) is never collectable — keep the dependent.
                 return
             owner_key = ref.get("name") \
-                if owner_res in CLUSTER_SCOPED_RESOURCES \
+                if self._cluster_scoped(owner_res) \
                 else f"{ns}/{ref.get('name')}"
             try:
                 owner = await self.store.get(owner_res, owner_key)

@@ -100,6 +100,31 @@ _SCORE_ACTIVE = {
 }
 
 
+def compress_score_wire(host_scores: "np.ndarray") -> "np.ndarray":
+    """Pick the wire dtype for a dirty host-score plane.
+
+    f16 (2× relay bytes saved) only while it's faithful: weighted sums
+    past 1024 sit in f16's ≥0.5-resolution band (near-ties can flip vs
+    the host path) and past 65504 overflow to inf. Oversized planes
+    (plugin weights ~>10) ship f32 — 2× bytes on a rare path beats
+    silently diverging from host-score parity. Scaling instead would skew
+    this plane against the device-computed taint/fit/balanced terms it is
+    summed with (the fused program casts to f32 on device either way).
+    """
+    import math
+    if host_scores.size:
+        # Two reductions, no temporaries (this sits on the dirty-upload
+        # dispatch path): NaN/inf propagate through min/max, so the
+        # finiteness check falls out of the same pass.
+        lo, hi = float(host_scores.min()), float(host_scores.max())
+        if not (math.isfinite(lo) and math.isfinite(hi)):
+            raise ValueError("host score plane contains non-finite values")
+        amax = max(-lo, hi)
+    else:
+        amax = 0.0
+    return host_scores.astype(np.float16 if amax <= 1024.0 else np.float32)
+
+
 def _signature(plugin_name: str, pi: PodInfo) -> str:
     if plugin_name == "NodeName":
         return pi.node_name
@@ -1046,7 +1071,7 @@ class TPUBackend:
                 dev_mask = self._dev_base_mask[base_key] = \
                     self._put(np.packbits(static_mask, axis=1), "pn")
         if scores_modified:
-            dev_scores = self._put(host_scores.astype(np.float16), "pn")
+            dev_scores = self._put(compress_score_wire(host_scores), "pn")
         else:
             dev_scores = self._dev_zero_scores.get((P, N))
             if dev_scores is None:

@@ -37,6 +37,7 @@ from kubernetes_tpu.api.meta import (
     deep_copy,
     name_of,
     namespace_of,
+    new_uid,
     set_creation_timestamp,
 )
 
@@ -162,6 +163,11 @@ class MVCCStore:
         self._validators: dict[str, list[Callable[[dict], None]]] = {}
         self._mutators: dict[
             str, list[tuple[Callable[[dict], None], frozenset[str]]]] = {}
+        # CRD-registered kinds are store-local, not process globals: two
+        # stores in one process must not share custom kind mappings, and a
+        # deleted CRD must drop its entries (install_crd_support).
+        self.custom_kinds: dict[str, str] = {}
+        self.custom_cluster_scoped: set[str] = set()
 
     # -- helpers -----------------------------------------------------------
 
@@ -239,8 +245,26 @@ class MVCCStore:
         for fn, ops in self._mutators.get(resource, []):
             if op in ops:
                 fn(obj)
-        for fn in self._validators.get(resource, []):
-            fn(obj)
+        if op != "delete":  # schema validation guards writes, not removal
+            for fn in self._validators.get(resource, []):
+                fn(obj)
+
+    # -- kind/scope lookup (built-ins + this store's CRDs) ------------------
+
+    def resource_for_kind(self, kind: str) -> str | None:
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        return self.custom_kinds.get(kind) or KIND_TO_RESOURCE.get(kind)
+
+    def is_cluster_scoped(self, resource: str) -> bool:
+        from kubernetes_tpu.api.meta import CLUSTER_SCOPED_RESOURCES
+        return (resource in CLUSTER_SCOPED_RESOURCES
+                or resource in self.custom_cluster_scoped)
+
+    def kind_map(self) -> dict[str, str]:
+        from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
+        merged = dict(KIND_TO_RESOURCE)
+        merged.update(self.custom_kinds)
+        return merged
 
     # -- CRUD --------------------------------------------------------------
 
@@ -263,6 +287,11 @@ class MVCCStore:
             raise AlreadyExists(f"{resource} {key!r} already exists")
         self._admit(resource, obj)
         set_creation_timestamp(obj)
+        # The apiserver, not the client, owns uid assignment (registry
+        # store PrepareForCreate). Constructor-made objects already carry
+        # one; raw dicts (custom resources, YAML applies) get theirs here
+        # so ownerReferences/GC work uniformly.
+        obj["metadata"].setdefault("uid", new_uid())
         rv = self._next_rv()
         obj["metadata"]["resourceVersion"] = str(rv)
         obj = _maybe_freeze(obj)
@@ -355,6 +384,7 @@ class MVCCStore:
         current = table[key]
         if uid and current["metadata"].get("uid") != uid:
             raise Conflict(f"{resource} {key!r}: uid precondition failed")
+        self._admit(resource, current, "delete")
         del table[key]
         rv = self._next_rv()
         tomb = deep_copy(current)

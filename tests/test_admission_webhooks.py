@@ -175,9 +175,38 @@ class TestCRDs:
                     "kind": "TPUJob",
                     "metadata": {"name": "bad2", "namespace": "default"},
                     "spec": {"slices": 2, "topology": "3x3"}})  # enum
-            # The kind→resource mapping makes ktpuctl/GC aware of it.
+            # The kind→resource mapping is STORE-LOCAL (ADVICE r3): other
+            # stores in the process never see this CRD, and the process
+            # globals stay untouched.
             from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
-            assert KIND_TO_RESOURCE["TPUJob"] == "tpujobs"
+            assert store.resource_for_kind("TPUJob") == "tpujobs"
+            assert "TPUJob" not in KIND_TO_RESOURCE
+            other = new_cluster_store()
+            assert other.resource_for_kind("TPUJob") is None
+            other.stop()
+            store.stop()
+        run(body())
+
+    def test_crd_delete_deregisters_and_rescopes(self):
+        """Deleting a CRD drops its kind mapping + cluster scoping; a
+        re-created Namespaced CRD after a Cluster one must not inherit the
+        stale scope (ADVICE r3 finding)."""
+        async def body():
+            store = new_cluster_store()
+            install_crd_support(store)
+            crd = make_crd("widgets", "Widget", scope="Cluster")
+            await store.create("customresourcedefinitions", crd)
+            assert store.resource_for_kind("Widget") == "widgets"
+            assert store.is_cluster_scoped("widgets")
+            await store.delete("customresourcedefinitions",
+                               "widgets.ktpu.dev")
+            assert store.resource_for_kind("Widget") is None
+            assert not store.is_cluster_scoped("widgets")
+            # Re-create as Namespaced: scope follows the live CRD.
+            await store.create("customresourcedefinitions",
+                               make_crd("widgets", "Widget"))
+            assert store.resource_for_kind("Widget") == "widgets"
+            assert not store.is_cluster_scoped("widgets")
             store.stop()
         run(body())
 

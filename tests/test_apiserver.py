@@ -419,6 +419,87 @@ class TestDiscoveryAndAggregation:
             store.stop()
         run(body())
 
+    def test_remote_store_learns_crd_scope_from_discovery(self):
+        """CRD scope is store-local server-side; a RemoteStore must learn
+        it via /api/v1 discovery or cluster-scoped custom resources would
+        silently list empty through namespaced URLs."""
+        async def body():
+            from kubernetes_tpu.apiserver.admission import (
+                install_crd_support, make_crd)
+            store = new_cluster_store()
+            install_core_validation(store)
+            install_crd_support(store)
+            await store.create("customresourcedefinitions",
+                               make_crd("tpuslices", "TPUSlice",
+                                        scope="Cluster"))
+            srv = APIServer(store)
+            await srv.start()
+            from kubernetes_tpu.apiserver import RemoteStore
+            rs = RemoteStore(srv.url)
+            await rs.refresh_discovery()
+            assert rs.is_cluster_scoped("tpuslices")
+            assert rs.resource_for_kind("TPUSlice") == "tpuslices"
+            await rs.create("tpuslices", {
+                "kind": "TPUSlice", "metadata": {"name": "s0"}})
+            # namespace arg must not produce a namespaced URL for a
+            # cluster-scoped resource (would filter to empty).
+            lst = await rs.list("tpuslices", namespace="default")
+            assert [o["metadata"]["name"] for o in lst.items] == ["s0"]
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_aggregator_strips_credentials_forwards_identity(self):
+        """The proxy must NOT forward client bearer tokens/cookies to
+        extension servers (an APIService creator could harvest every
+        caller's credential); identity rides X-Remote-User instead —
+        kube-aggregator's requestheader pattern (ADVICE r3)."""
+        async def body():
+            from aiohttp import web as aioweb
+            seen = {}
+
+            async def extension(request):
+                seen.update(request.headers)
+                return aioweb.json_response({"kind": "Status"})
+
+            ext_app = aioweb.Application()
+            ext_app.router.add_route("*", "/apis/ext.ktpu.dev/{tail:.*}",
+                                     extension)
+            runner = aioweb.AppRunner(ext_app)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            ext_port = site._server.sockets[0].getsockname()[1]
+
+            store, srv = await _serve(
+                bearer_tokens={"sekret": "alice"},
+                user_groups={"alice": ["sre"]})
+            await store.create("apiservices", {
+                "kind": "APIService",
+                "metadata": {"name": "v1.ext.ktpu.dev"},
+                "spec": {"group": "ext.ktpu.dev", "version": "v1",
+                         "service": {
+                             "url": f"http://127.0.0.1:{ext_port}"}}})
+            import aiohttp
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        srv.url + "/apis/ext.ktpu.dev/v1/widgets",
+                        headers={"Authorization": "Bearer sekret",
+                                 "Cookie": "session=abc",
+                                 "X-Remote-User": "spoofed",
+                                 "X-Remote-Extra-Scopes": "admin"}) as r:
+                    assert r.status == 200
+            assert "Authorization" not in seen
+            assert "Cookie" not in seen
+            assert "X-Remote-Extra-Scopes" not in seen
+            assert seen.get("X-Remote-User") == "alice"  # not "spoofed"
+            assert seen.get("X-Remote-Group") == "sre,system:authenticated"
+            await srv.stop()
+            await runner.cleanup()
+            store.stop()
+        run(body())
+
     def test_resource_list_discovery(self):
         async def body():
             store, srv = await _serve()

@@ -127,6 +127,41 @@ class TestGCCascade:
         run(body())
 
 
+class TestGraphHygiene:
+    def test_mixed_watched_unwatched_owners_leave_no_graph_entries(self):
+        """A dependent with one watched + one UNWATCHED owner kind is never
+        collectable; it must leave NO _dependents entries behind (the
+        ADVICE r3 map leak: per-ref writes before the collectable check
+        stranded entries that enqueued spurious sync work forever)."""
+        async def body():
+            store, mgr, teardown = await gc_stack(
+                [GarbageCollectorController])
+            gc = mgr.controllers[0]
+            created = await store.create("deployments", make_deployment(
+                "web", 1, {"matchLabels": {"app": "web"}}, DEPLOY_TEMPLATE))
+            pod = make_pod("mixed", "default")
+            pod["metadata"]["ownerReferences"] = [
+                {"kind": "Deployment", "name": "web",
+                 "uid": created["metadata"]["uid"]},
+                # Node is not a GC-watched resource → never collectable.
+                {"kind": "Node", "name": "n0", "uid": "node-uid"},
+            ]
+            await store.create("pods", pod)
+            await wait_for(lambda: asyncio.sleep(0.1, True))
+            key = ("pods", "default/mixed")
+            assert key not in gc._owners_of
+            assert all(key not in deps
+                       for deps in gc._dependents.values()), \
+                "unwatched-owner dependent leaked into _dependents"
+            # And the pod survives owner deletion (kept forever).
+            await store.delete("deployments", "default/web")
+            await asyncio.sleep(0.3)
+            got = await store.get("pods", "default/mixed")
+            assert got["metadata"]["name"] == "mixed"
+            await teardown()
+        run(body())
+
+
 class TestNamespaceFanout:
     def test_namespace_delete_purges_contents(self):
         async def body():

@@ -90,6 +90,73 @@ class TestRBAC:
         assert authz.allowed("root", "delete", "pods")
         assert not authz.allowed("mallory", "get", "pods")
 
+    def test_group_bindings_track_membership_not_names(self):
+        """A Group binding grants members of the group (via groups=) and
+        never a USER who merely shares the group's name (ADVICE r3)."""
+        authz = RBACAuthorizer(
+            roles=[make_cluster_role("admin", [
+                {"verbs": ["*"], "resources": ["*"]}])])
+        authz.add_binding({
+            "roleRef": {"kind": "ClusterRole", "name": "admin"},
+            "subjects": [{"kind": "Group", "name": "admins"}]})
+        # user literally named "admins" gets nothing
+        assert not authz.allowed("admins", "delete", "pods")
+        # a member of the group does
+        assert authz.allowed("alice", "delete", "pods", groups=["admins"])
+        assert not authz.allowed("alice", "delete", "pods", groups=["dev"])
+
+    def test_serviceaccount_subject_maps_to_token_username(self):
+        authz = RBACAuthorizer(
+            roles=[make_cluster_role("reader", [
+                {"verbs": ["get"], "resources": ["pods"]}])])
+        authz.add_binding({
+            "roleRef": {"kind": "ClusterRole", "name": "reader"},
+            "subjects": [{"kind": "ServiceAccount", "name": "builder",
+                          "namespace": "ci"}]})
+        assert authz.allowed("system:serviceaccount:ci:builder",
+                             "get", "pods")
+        assert not authz.allowed("builder", "get", "pods")
+
+    def test_apiserver_group_membership_authz(self):
+        """user_groups on the server feeds Group bindings end-to-end,
+        including the implicit system:authenticated group."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            authz = RBACAuthorizer(
+                roles=[make_cluster_role("podadmin", [
+                    {"verbs": ["*"], "resources": ["pods"]}]),
+                    make_cluster_role("discovery", [
+                        {"verbs": ["get", "list"],
+                         "resources": ["namespaces"]}])])
+            authz.add_binding({
+                "roleRef": {"kind": "ClusterRole", "name": "podadmin"},
+                "subjects": [{"kind": "Group", "name": "sre"}]})
+            authz.add_binding({
+                "roleRef": {"kind": "ClusterRole", "name": "discovery"},
+                "subjects": [{"kind": "Group",
+                              "name": "system:authenticated"}]})
+            srv = APIServer(
+                store,
+                bearer_tokens={"t-a": "alice", "t-b": "bob"},
+                user_groups={"alice": ["sre"]},
+                authorizer=authz)
+            await srv.start()
+            a = RemoteStore(srv.url, token="t-a")
+            created = await a.create("pods", make_pod("p1"))
+            assert created["metadata"]["name"] == "p1"
+            # bob is authenticated (namespaces OK) but not in sre (pods 403)
+            b = RemoteStore(srv.url, token="t-b")
+            await b.list("namespaces")
+            from kubernetes_tpu.store.mvcc import StoreError
+            with pytest.raises(StoreError):
+                await b.create("pods", make_pod("p2"))
+            await a.close()
+            await b.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
     def test_apiserver_enforces_rbac(self):
         async def body():
             store = new_cluster_store()

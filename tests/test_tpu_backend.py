@@ -107,6 +107,22 @@ def default_fwk():
 # kernel-level differential
 # ---------------------------------------------------------------------------
 
+class TestScoreWire:
+    def test_f16_within_band_f32_beyond(self):
+        """Dirty score planes ship f16 only inside its faithful range;
+        oversized plugin weights (sums >1024) must fall back to f32 and
+        never reach the device as inf (ADVICE r3)."""
+        from kubernetes_tpu.ops.backend import compress_score_wire
+        small = np.full((4, 8), 600.0, dtype=np.float32)
+        assert compress_score_wire(small).dtype == np.float16
+        big = np.full((4, 8), 700.0 * 100, dtype=np.float32)  # weight 700
+        wire = compress_score_wire(big)
+        assert wire.dtype == np.float32
+        assert np.isfinite(wire).all()
+        assert compress_score_wire(np.zeros((0, 0), np.float32)).dtype \
+            == np.float16
+
+
 class TestKernelsVsHost:
     def setup_method(self):
         self.rng = random.Random(7)
