@@ -43,12 +43,19 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=8192,
                     help="pods popped per scheduling super-batch; the "
                          "backend chunks + pipelines internally")
-    ap.add_argument("--chunk", type=int, default=2048,
-                    help="backend solve chunk (jit batch signature)")
+    ap.add_argument("--chunk", type=int, default=1024,
+                    help="backend solve chunk (jit batch signature); "
+                         "smaller chunks pipeline better against binding "
+                         "traffic now that assignments stream per chunk")
     ap.add_argument("--through-apiserver", action="store_true",
                     help="cross the process boundary: workload writes, "
-                         "informers, and binding POSTs go over the HTTP "
+                         "informers, and binding POSTs go over the "
                          "apiserver (reference scheduler_perf topology)")
+    ap.add_argument("--transport", choices=["wire", "http"], default="wire",
+                    help="apiserver transport for --through-apiserver: "
+                         "'wire' = the multiplexed framed wire core "
+                         "components use (the reference's HTTP/2+protobuf "
+                         "analog); 'http' = per-request HTTP/1.1+JSON")
     ap.add_argument("--profile-dir", default="",
                     help="write a jax.profiler device trace of the "
                          "MEASURED phase to this directory (tpu backend "
@@ -100,8 +107,11 @@ def main(argv=None) -> int:
     if args.profile_dir and backend is None:
         print("warning: --profile-dir needs --backend tpu; no trace "
               "will be written", file=sys.stderr)
+    boundary = False
+    if args.through_apiserver:
+        boundary = "wire" if args.transport == "wire" else True
     runner = PerfRunner(backend=backend, batch_size=batch,
-                        through_apiserver=args.through_apiserver,
+                        through_apiserver=boundary,
                         profile_dir=args.profile_dir or None)
     res = asyncio.run(runner.run(template, params, timeout=1800.0))
 
@@ -110,7 +120,8 @@ def main(argv=None) -> int:
                       "backend": args.backend}, ), file=sys.stderr)
     print(json.dumps({
         "metric": f"pods_per_sec_{args.preset}_nodes_{args.backend}"
-                  + ("_apiserver" if args.through_apiserver else ""),
+                  + (f"_apiserver_{args.transport}"
+                     if args.through_apiserver else ""),
         "value": detail["throughput_pods_per_sec"],
         "unit": "pods/s",
         "vs_baseline": round(

@@ -209,23 +209,10 @@ class RemoteStore:
         max_retries: int = 16, return_copy: bool = True,
     ) -> dict | None:
         """Client-side CAS loop (util/retry.RetryOnConflict)."""
-        for _ in range(max_retries):
-            current = await self.get(resource, key)
-            want_rv = current["metadata"]["resourceVersion"]
-            pristine = copy.deepcopy(current) if return_copy else None
-            updated = mutate(current)
-            if updated is None:
-                # mutate may have scribbled on `current`; the pristine copy
-                # honors the "unchanged" contract without a second GET.
-                return pristine
-            updated["metadata"]["resourceVersion"] = want_rv
-            try:
-                out = await self.update(resource, updated)
-                return out if return_copy else None
-            except Conflict:
-                continue
-        raise Conflict(
-            f"{resource} {key!r}: too many conflicts in guaranteed_update")
+        from kubernetes_tpu.client.retry import retry_on_conflict
+        return await retry_on_conflict(
+            self, resource, key, mutate,
+            max_retries=max_retries, return_copy=return_copy)
 
     async def subresource(self, resource: str, key: str, sub: str,
                           body: Mapping) -> dict:

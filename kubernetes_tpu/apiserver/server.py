@@ -655,16 +655,20 @@ class APIServer:
             status=200,
             headers={"Content-Type": "application/json;stream=watch"})
         await resp.prepare(request)
+        from kubernetes_tpu.apiserver.wire import encode_event_object
         try:
             async for ev in watch:
                 if ev.type == "BOOKMARK":
-                    frame = {"type": "BOOKMARK", "object": {"metadata": {
-                        "resourceVersion": str(ev.rv)}}}
+                    frame = (b'{"type":"BOOKMARK","object":{"metadata":'
+                             b'{"resourceVersion":"' + str(ev.rv).encode()
+                             + b'"}}}\n')
                 else:
-                    frame = {"type": ev.type, "object": ev.object}
-                await resp.write(
-                    json.dumps(frame, separators=(",", ":")).encode()
-                    + b"\n")
+                    # Spliced frame: object bytes encoded once per event
+                    # across every watcher (HTTP and wire — SURVEY §3.2).
+                    frame = (b'{"type":"' + ev.type.encode()
+                             + b'","object":' + encode_event_object(ev)
+                             + b'}\n')
+                await resp.write(frame)
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:

@@ -27,14 +27,29 @@ class EventRecorder:
     instead of one asyncio task per event — at scheduler_perf scale the
     per-event task + write copies were a top host cost."""
 
+    #: Bounded queue, reference semantics: record.NewBroadcaster(1000)
+    #: with DropIfChannelFull — under a scheduling burst the sink cannot
+    #: keep up, and events beyond the buffer are dropped (counted), never
+    #: allowed to backpressure the scheduling path.
+    MAX_PENDING = 1000
+
     def __init__(self, store: MVCCStore, component: str):
         self.store = store
         self.component = component
         self._pending: list[dict] = []
         self._draining = False
+        self.dropped = 0
 
     def event(self, obj: Mapping, event_type: str, reason: str, message: str) -> None:
         """Fire-and-forget, like the reference's buffered broadcaster."""
+        if len(self._pending) >= self.MAX_PENDING:
+            self.dropped += 1
+            if self.dropped % 1000 == 1:
+                logger.warning(
+                    "event buffer full (%d pending); dropped %d events so "
+                    "far (DropIfChannelFull)", len(self._pending),
+                    self.dropped)
+            return
         ev = new_object(
             "Event",
             f"{name_of(obj)}.{next(_seq):x}",

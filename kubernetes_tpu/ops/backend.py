@@ -724,13 +724,29 @@ class TPUBackend:
         """Pipelined driver for the scheduler's event loop: same chunk
         pipeline as assign(), with the device→host fetch awaited in a worker
         thread so binding tasks keep draining during the device/relay wait."""
+        ctx = None
+        async for _chunk_pods, ctx in self.assign_stream(pods, snapshot, fwk):
+            pass
+        if ctx is None:  # empty batch
+            return {}, {}
+        return ctx.assignments, ctx.diagnostics
+
+    async def assign_stream(self, pods: Sequence[PodInfo], snapshot: Snapshot,
+                            fwk: Framework):
+        """Chunk-streaming driver: yields (chunk_pods, ctx) as each chunk's
+        host verify completes, so the CALLER's per-pod work (assume →
+        Reserve → bindingCycle wire writes) overlaps the NEXT chunk's
+        device solve instead of waiting for the whole super-batch — the
+        schedule_one/bind asynchrony of SURVEY §2.8 applied between device
+        and API boundary. ctx.assignments/diagnostics accumulate; the
+        chunk's own keys are final once yielded."""
         import asyncio
 
         ctx = self._start(pods, snapshot, fwk)
         for run in self._pipeline(ctx):
             got = await asyncio.to_thread(np.asarray, run["assign_d"])
             self._finalize_chunk(run, got, ctx)
-        return ctx.assignments, ctx.diagnostics
+            yield run["pods"], ctx
 
     def _pipeline(self, ctx: "_AssignCtx"):
         """Yield dispatched chunk runs in finalize order, keeping up to
@@ -836,25 +852,30 @@ class TPUBackend:
         filter_names = {p.NAME for p in fwk.filter_plugins}
         score_plugins = {p.NAME: p for p in fwk.score_plugins}
 
-        # Base mask: real pods × valid nodes. Tracked copy-on-write so the
-        # unmodified case can reuse a cached device array (no re-upload).
+        # Base mask: real pods × valid nodes. LAZY copy-on-write: the
+        # pristine (P,N) block pattern is 40+ MB at 8k×5k — allocating and
+        # zeroing it per chunk costs more than most chunks' entire host
+        # work, so it materializes only when a plugin actually writes a
+        # row; the unmodified case reuses a cached device array.
         base_key = (P, N, batch.p_real, ct.n_real)
-        static_mask = np.zeros((P, N), dtype=np.bool_)
-        static_mask[: batch.p_real, : ct.n_real] = True
+        static_mask: np.ndarray | None = None
         mask_modified = False
 
-        def _mark_mask_modified():
-            nonlocal mask_modified
-            mask_modified = True
+        def _get_mask() -> np.ndarray:
+            nonlocal static_mask, mask_modified
+            if static_mask is None:
+                static_mask = np.zeros((P, N), dtype=np.bool_)
+                static_mask[: batch.p_real, : ct.n_real] = True
+                mask_modified = True
+            return static_mask
 
         # Pods requesting resources no tracked column covers are infeasible
         # everywhere (would silently drop a constraint on device).
         unknown_res: set[int] = set()
         for i, pi in enumerate(pods):
             if ct.has_unknown_resource(pi.requests):
-                static_mask[i, :] = False
+                _get_mask()[i, :] = False
                 unknown_res.add(i)
-                _mark_mask_modified()
 
         # Host-side rows: static predicate plugins (signature-cached) and
         # stateful irregular plugins (per pod, Skip-gated).
@@ -880,8 +901,7 @@ class TPUBackend:
             if ok is None:  # setdefault would allocate the array per call
                 ok = host_filter_fail[pname] = np.ones((P, N), dtype=np.bool_)
             ok[i, : ct.n_real] &= row
-            static_mask[i, : ct.n_real] &= row
-            _mark_mask_modified()
+            _get_mask()[i, : ct.n_real] &= row
 
         for plugin in fwk.filter_plugins:
             if plugin.NAME in DEVICE_FILTER_PLUGINS:
@@ -951,10 +971,19 @@ class TPUBackend:
         # here must match the full Filter outcome — static rows ∧ taints ∧
         # exact fit — or min-max normalizations get skewed by scores of
         # nodes the solver will mask anyway.
-        host_scores = np.zeros((P, N), dtype=np.float32)
+        # Same lazy treatment: the (P,N) float32 plane is ~170 MB at
+        # 8k×5k; zeroing it per chunk dwarfs the basic families' host work.
+        host_scores: np.ndarray | None = None
         scores_modified = False
         fit_np: np.ndarray | None = None
         taint_np: np.ndarray | None = None
+
+        def _get_scores() -> np.ndarray:
+            nonlocal host_scores, scores_modified
+            if host_scores is None:
+                host_scores = np.zeros((P, N), dtype=np.float32)
+                scores_modified = True
+            return host_scores
 
         def feasible_idx(i: int) -> np.ndarray:
             nonlocal fit_np, taint_np
@@ -966,8 +995,9 @@ class TPUBackend:
                 else:
                     taint_np = np.ones(
                         (P, ct.taint_filter_mat.shape[0]), dtype=np.bool_)
-            feas = (static_mask[i, : ct.n_real] & fit_np[i, : ct.n_real]
-                    & taint_np[i, : ct.n_real])
+            feas = fit_np[i, : ct.n_real] & taint_np[i, : ct.n_real]
+            if static_mask is not None:
+                feas &= static_mask[i, : ct.n_real]
             return np.nonzero(feas)[0]
 
         for name, plugin in score_plugins.items():
@@ -996,8 +1026,7 @@ class TPUBackend:
                         st_nrt = self._nrt_state(plugin, snapshot, ct)
                         srow = self._nrt_score_row(st_nrt, pi, nrt_memo, i)
                         if srow.any():
-                            host_scores[i, : ct.n_real] += w * srow
-                            scores_modified = True
+                            _get_scores()[i, : ct.n_real] += w * srow
                         continue
                     if name == "PodTopologySpread":
                         # Tensorized raw counts + vectorized NormalizeScore
@@ -1017,8 +1046,7 @@ class TPUBackend:
                                     norm = 100.0 * (mx - vals) / (mx - mn)
                                 else:
                                     norm = np.full_like(vals, 100.0)
-                                host_scores[i, feas] += w * norm
-                                scores_modified = True
+                                _get_scores()[i, feas] += w * norm
                             continue
                     if name == "InterPodAffinity":
                         if not self._ipa_score_relevant(pi, snapshot):
@@ -1040,8 +1068,7 @@ class TPUBackend:
                                 mx, mn = vals.max(), vals.min()
                                 if mx > mn:
                                     norm = 100.0 * (vals - mn) / (mx - mn)
-                                    host_scores[i, feas] += w * norm
-                                    scores_modified = True
+                                    _get_scores()[i, feas] += w * norm
                             continue
                         # namespaceSelector terms → host slow path below.
                     state = dyn_states.setdefault(i, CycleState())
@@ -1053,9 +1080,10 @@ class TPUBackend:
                            for ni in nodes_i}
                 state = dyn_states.get(i) or CycleState()
                 plugin.normalize_scores(state, pi, raw)
-                for nname, s in raw.items():
-                    host_scores[i, ct.name_to_idx[nname]] += w * s
-                scores_modified = True
+                if raw:
+                    hs = _get_scores()
+                    for nname, s in raw.items():
+                        hs[i, ct.name_to_idx[nname]] += w * s
 
         # Reuse device-resident constants when untouched (remote-TPU upload
         # bandwidth is the bottleneck at 5k nodes). Dirty uploads are
@@ -1069,14 +1097,14 @@ class TPUBackend:
             dev_mask = self._dev_base_mask.get(base_key)
             if dev_mask is None:
                 dev_mask = self._dev_base_mask[base_key] = \
-                    self._put(np.packbits(static_mask, axis=1), "pn")
+                    self._put(np.packbits(_get_mask(), axis=1), "pn")
         if scores_modified:
             dev_scores = self._put(compress_score_wire(host_scores), "pn")
         else:
             dev_scores = self._dev_zero_scores.get((P, N))
             if dev_scores is None:
                 dev_scores = self._dev_zero_scores[(P, N)] = \
-                    self._put(host_scores.astype(np.float16), "pn")
+                    self._put(np.zeros((P, N), dtype=np.float16), "pn")
 
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are

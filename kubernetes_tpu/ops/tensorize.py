@@ -259,12 +259,18 @@ class PodBatch:
         tp = ct.taint_prefer_mat.shape[1]
         self.untol_filter = np.zeros((P, tf), dtype=np.bool_)
         self.untol_prefer = np.zeros((P, tp), dtype=np.bool_)
-        # Toleration vectors cached by signature: workload pods come from
-        # templates, so distinct toleration lists are few.
+        # Row vectors cached by signature: workload pods come from
+        # templates (the reference's equivalence-class observation), so
+        # distinct request shapes / toleration lists are few per batch.
         tol_cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        req_cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         for i, pi in enumerate(pods):
-            self.req_q[i], self.req_nz_q[i] = ct.quantize_requests(
-                pi.requests, pi.nonzero_requests)
+            rsig = repr(pi.requests) + "|" + repr(pi.nonzero_requests)
+            rows = req_cache.get(rsig)
+            if rows is None:
+                rows = req_cache[rsig] = ct.quantize_requests(
+                    pi.requests, pi.nonzero_requests)
+            self.req_q[i], self.req_nz_q[i] = rows
             sig = repr(pi.tolerations)
             cached = tol_cache.get(sig)
             if cached is None:

@@ -96,6 +96,20 @@ DEFAULT_POD_TEMPLATE = {
     "requests": {"cpu": "100m", "memory": "250Mi"}}
 
 
+class _ServerPair:
+    """The apiserver processes backing a boundary-crossing run: the HTTP
+    server (policy owner) and, in wire mode, the framed-wire listener."""
+
+    def __init__(self, api, wire):
+        self.api = api
+        self.wire = wire
+
+    async def stop(self) -> None:
+        if self.wire is not None:
+            await self.wire.stop()
+        await self.api.stop()
+
+
 class PerfRunner:
     """Executes one workload (template ops + params) against an in-process
     store + scheduler, mirroring mustSetupCluster → runWorkload."""
@@ -114,7 +128,9 @@ class PerfRunner:
         #: Cross the process boundary like the reference's scheduler_perf
         #: (in-process apiserver + REAL wire): all traffic — workload
         #: writes, the scheduler's informers, and binding POSTs — goes over
-        #: the HTTP apiserver instead of direct store calls.
+        #: the apiserver instead of direct store calls. True/"http" = the
+        #: HTTP/1.1+JSON wire; "wire" = the KTPU multiplexed framed wire
+        #: (the HTTP/2 analog core components use — apiserver/wire.py).
         self.through_apiserver = through_apiserver
         #: jax.profiler trace of the MEASURED phase only (not warmup/jit
         #: compile) when the backend supports it.
@@ -127,12 +143,27 @@ class PerfRunner:
         server = None
         client = None
         try:
-            if self.through_apiserver:
+            if self.through_apiserver == "wire":
+                # The core-component transport: HTTP server up (policy
+                # lives there), store traffic over the multiplexed wire.
+                from kubernetes_tpu.apiserver.server import APIServer
+                from kubernetes_tpu.apiserver.wire import (
+                    WireServer,
+                    WireStore,
+                )
+                server = _ServerPair(APIServer(backing), None)
+                await server.api.start()
+                server.wire = WireServer.for_apiserver(
+                    server.api, host="unix:")
+                await server.wire.start()
+                client = WireStore(server.wire.target)
+                store = client
+            elif self.through_apiserver:
                 from kubernetes_tpu.apiserver.client import RemoteStore
                 from kubernetes_tpu.apiserver.server import APIServer
-                server = APIServer(backing)
-                await server.start()
-                client = RemoteStore(server.url)
+                server = _ServerPair(APIServer(backing), None)
+                await server.api.start()
+                client = RemoteStore(server.api.url)
                 store = client
             else:
                 store = backing
@@ -221,12 +252,14 @@ class PerfRunner:
                     # Writes go out in concurrent windows (the reference
                     # harness drives the apiserver with multi-goroutine
                     # client QPS; serial awaits would make the HTTP
-                    # boundary the benchmark).
-                    for lo in range(0, count, 128):
+                    # boundary the benchmark). 512-wide windows let the
+                    # wire transport coalesce a whole window into one
+                    # multiplexed frame.
+                    for lo in range(0, count, 512):
                         await asyncio.gather(*(
                             store.create("pods", make_pod(
                                 name, **copy.deepcopy(tmpl)))
-                            for name in names[lo:lo + 128]))
+                            for name in names[lo:lo + 512]))
                     pod_seq += count
                     created_total += count
                     if measured:

@@ -440,6 +440,12 @@ class Scheduler:
         fwk = self.profiles.get(pods[0].scheduler_name) or next(iter(self.profiles.values()))
         t0 = time.perf_counter()
         try:
+            if hasattr(self.backend, "assign_stream"):
+                # Chunk-streaming path: bindings for chunk k start while
+                # chunk k+1 still solves on device — the device and the
+                # API-boundary wire stay busy simultaneously.
+                return await self._schedule_via_backend_stream(
+                    pods, snapshot, fwk, t0)
             if hasattr(self.backend, "assign_async"):
                 # Pipelined path: device fetches run in a worker thread, so
                 # binding tasks keep draining during device/relay waits.
@@ -489,6 +495,74 @@ class Scheduler:
                 await self._handle_failure(
                     fwk, pi, FitError(pi, len(snapshot), statuses),
                     statuses, state=state, snapshot=live)
+
+    async def _schedule_via_backend_stream(self, pods: list[PodInfo],
+                                           snapshot, fwk, t0: float) -> None:
+        """Consume the backend's per-chunk assignment stream: each chunk's
+        assume/Reserve/bindingCycle work is spawned as soon as its host
+        verify lands, overlapping the next chunk's device solve."""
+        done: set[str] = set()
+        stream = self.backend.assign_stream(pods, snapshot, fwk)
+        while True:
+            # Only the DEVICE step is inside the failure domain: a
+            # host-side error in binding/failure handling must neither
+            # trip the backend circuit breaker nor strand the pod (the
+            # pre-stream path kept the same separation).
+            try:
+                chunk_pods, ctx = await stream.__anext__()
+                self._backend_failures = 0
+            except StopAsyncIteration:
+                break
+            except Exception:
+                self._backend_failures = getattr(
+                    self, "_backend_failures", 0) + 1
+                logger.exception(
+                    "TPU backend failed mid-stream (%d consecutive); host "
+                    "path for the rest of this batch",
+                    self._backend_failures)
+                self.metrics.schedule_attempts.inc(
+                    result="backend_fallback", profile=fwk.profile_name)
+                if self._backend_failures >= 3:
+                    logger.error(
+                        "TPU backend circuit OPEN after %d consecutive "
+                        "failures — host path only from here",
+                        self._backend_failures)
+                    self.backend = None
+                live = self.cache.update_snapshot()
+                for pi in pods:
+                    if pi.key in done:
+                        continue
+                    await self._schedule_host_path(pi, live)
+                    live = self.cache.update_snapshot()
+                return
+            elapsed = time.perf_counter() - t0
+            n = max(1, len(chunk_pods))
+            for pi in chunk_pods:
+                done.add(pi.key)
+                node = ctx.assignments.get(pi.key)
+                if node:
+                    self.metrics.observe_attempt(
+                        "scheduled", fwk.profile_name, elapsed / n)
+                    await self._assume_and_bind(
+                        fwk, CycleState(), pi, node)
+                else:
+                    self.metrics.observe_attempt(
+                        "unschedulable", fwk.profile_name, elapsed / n)
+                    statuses = ctx.diagnostics.get(pi.key, {})
+                    live = self.cache.update_snapshot()
+                    state = CycleState()
+                    fwk.run_pre_filter(state, pi, live)
+                    try:
+                        await self._handle_failure(
+                            fwk, pi,
+                            FitError(pi, len(snapshot), statuses),
+                            statuses, state=state, snapshot=live)
+                    except Exception:
+                        # Infrastructure error (e.g. an eviction write
+                        # failed): the pod must not silently vanish.
+                        logger.exception(
+                            "failure handling errored for %s", pi.key)
+                        await self.queue.move_to_backoff(pi)
 
     async def _schedule_host_path(self, pi: PodInfo, snapshot) -> None:
         fwk = self.profiles.get(pi.scheduler_name)

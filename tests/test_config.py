@@ -244,13 +244,14 @@ class TestTPUScorerGate:
                                     feature_gates=gates(TPUScorer=True),
                                     seed=42)
             calls = []
-            orig = sched.backend.assign_async
+            orig = sched.backend.assign_stream
 
             async def spy(pods, snapshot, fwk):
                 calls.append(len(pods))
-                return await orig(pods, snapshot, fwk)
+                async for item in orig(pods, snapshot, fwk):
+                    yield item
 
-            sched.backend.assign_async = spy
+            sched.backend.assign_stream = spy
             factory = InformerFactory(store)
             await sched.setup_informers(factory)
             factory.start()
@@ -293,13 +294,14 @@ class TestTPUScorerGate:
                                     feature_gates=gates(TPUScorer=True),
                                     seed=42)
             backend_pods = []
-            orig = sched.backend.assign_async
+            orig = sched.backend.assign_stream
 
             async def spy(pods, snapshot, fwk):
                 backend_pods.extend(p.key for p in pods)
-                return await orig(pods, snapshot, fwk)
+                async for item in orig(pods, snapshot, fwk):
+                    yield item
 
-            sched.backend.assign_async = spy
+            sched.backend.assign_stream = spy
             factory = InformerFactory(store)
             await sched.setup_informers(factory)
             factory.start()
