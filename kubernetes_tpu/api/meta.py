@@ -146,10 +146,16 @@ KIND_TO_RESOURCE = {
     "ResourceQuota": "resourcequotas",
     "PodDisruptionBudget": "poddisruptionbudgets",
     "HorizontalPodAutoscaler": "horizontalpodautoscalers",
+    # DRA (resource.k8s.io structured parameters — SURVEY §2.3
+    # dynamicresources/, §2.5 devicemanager): the modern device path.
+    "ResourceClaim": "resourceclaims",
+    "ResourceClaimTemplate": "resourceclaimtemplates",
+    "DeviceClass": "deviceclasses",
+    "ResourceSlice": "resourceslices",
 }
 
 #: resources without a namespace segment in their keys/URLs.
 CLUSTER_SCOPED_RESOURCES = {
     "nodes", "namespaces", "persistentvolumes", "storageclasses",
-    "noderesourcetopologies",
+    "noderesourcetopologies", "deviceclasses", "resourceslices",
 }

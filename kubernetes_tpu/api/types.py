@@ -52,6 +52,7 @@ def make_pod(
     host_ports: list[int] | None = None,
     phase: str = "Pending",
     uid: str | None = None,
+    resource_claims: list | None = None,
 ) -> dict:
     container: dict[str, Any] = {"name": "main", "image": "app"}
     res: dict[str, Any] = {}
@@ -78,6 +79,10 @@ def make_pod(
         spec["topologySpreadConstraints"] = list(topology_spread_constraints)
     if scheduling_gates:
         spec["schedulingGates"] = [{"name": g} for g in scheduling_gates]
+    if resource_claims:
+        # DRA claim references: [{"name": ..., "resourceClaimName": ...}]
+        # or {"resourceClaimTemplateName": ...} entries.
+        spec["resourceClaims"] = list(resource_claims)
     pod = new_object("Pod", name, namespace, labels=labels, spec=spec,
                      status={"phase": phase})
     if uid:
@@ -170,6 +175,60 @@ def make_storage_class(name: str, *,
         sc["metadata"].setdefault("annotations", {})[
             "storageclass.kubernetes.io/is-default-class"] = "true"
     return sc
+
+
+def make_device_class(name: str,
+                      selectors: Mapping[str, str] | None = None) -> dict:
+    """resource.k8s.io DeviceClass (structured parameters). `selectors`
+    are attribute equality matchers — the tractable core of the
+    reference's CEL selectors (`pkg/apis/resource/types.go DeviceClass`):
+    a device belongs to the class iff every (attr, value) pair matches."""
+    dc = new_object("DeviceClass", name, None,
+                    api_version="resource.k8s.io/v1")
+    dc["spec"] = {"selectors": dict(selectors or {})}
+    return dc
+
+
+def make_resource_slice(node_name: str, driver: str,
+                        devices: list[dict],
+                        name: str | None = None) -> dict:
+    """resource.k8s.io ResourceSlice: the per-node device inventory a DRA
+    driver publishes (reference `ResourceSlice` / kubelet plugin
+    ListAndWatch — SURVEY §2.5 devicemanager). `devices` entries:
+    {"name": "tpu-0", "attributes": {"type": "tpu", "numa": "0"}}."""
+    rs = new_object("ResourceSlice", name or f"{node_name}-{driver}", None,
+                    api_version="resource.k8s.io/v1")
+    rs["spec"] = {"nodeName": node_name, "driver": driver,
+                  "devices": list(devices)}
+    return rs
+
+
+def make_resource_claim(name: str, namespace: str = "default",
+                        requests: list[dict] | None = None,
+                        constraints: list[dict] | None = None) -> dict:
+    """resource.k8s.io ResourceClaim. `requests` entries:
+    {"name": "tpus", "deviceClassName": "tpu", "count": 4}; `constraints`
+    entries: {"matchAttribute": "numa"} — all allocated devices must agree
+    on that attribute (the reference's MatchAttribute constraint; this is
+    how single-NUMA alignment is expressed the DRA way)."""
+    rc = new_object("ResourceClaim", name, namespace,
+                    api_version="resource.k8s.io/v1")
+    rc["spec"] = {"devices": {"requests": list(requests or []),
+                              "constraints": list(constraints or [])}}
+    return rc
+
+
+def make_resource_claim_template(name: str, namespace: str = "default",
+                                 requests: list[dict] | None = None,
+                                 constraints: list[dict] | None = None
+                                 ) -> dict:
+    """ResourceClaimTemplate: per-pod claims stamped out by the
+    resourceclaim controller for pods referencing the template."""
+    t = new_object("ResourceClaimTemplate", name, namespace,
+                   api_version="resource.k8s.io/v1")
+    t["spec"] = {"devices": {"requests": list(requests or []),
+                             "constraints": list(constraints or [])}}
+    return t
 
 
 def make_node_resource_topology(

@@ -120,6 +120,17 @@ class _Conn(asyncio.Protocol):
         self._out: list[bytes] = []
         self._flush_scheduled = False
         self._closed = False
+        #: transport backpressure (pause_writing/resume_writing): watch
+        #: pumps await this so a slow consumer parks its pumps instead of
+        #: growing the transport buffer without bound.
+        self._drained = asyncio.Event()
+        self._drained.set()
+
+    def pause_writing(self) -> None:
+        self._drained.clear()
+
+    def resume_writing(self) -> None:
+        self._drained.set()
 
     # -- transport ---------------------------------------------------------
 
@@ -406,6 +417,12 @@ class _Conn(asyncio.Protocol):
                 self.send(body)
                 if self._closed:
                     return
+                if not self._drained.is_set():
+                    # Slow consumer: park this pump until the transport
+                    # drains (the HTTP path got this via `await write`).
+                    # The store watch channel buffers meanwhile, bounded
+                    # by its event window.
+                    await self._drained.wait()
         except asyncio.CancelledError:
             raise
         except Exception as e:

@@ -43,6 +43,7 @@ from kubernetes_tpu.controllers.replicaset import (
     ReplicaSetController,
     make_replicaset,
 )
+from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
 from kubernetes_tpu.controllers.statefulset import (
     StatefulSetController,
     make_statefulset,
@@ -70,5 +71,6 @@ __all__ = [
     "KwokController", "NodeLifecycleController", "PodGCController",
     "PVBinderController",
     "ReplicaSetController", "make_replicaset",
+    "ResourceClaimController",
     "StatefulSetController", "make_statefulset",
 ]

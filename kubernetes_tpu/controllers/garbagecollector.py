@@ -45,6 +45,7 @@ GC_RESOURCES = [
     "daemonsets",
     "podgroups",
     "persistentvolumeclaims",
+    "resourceclaims",
 ]
 
 #: Namespaced resources purged on namespace deletion.

@@ -88,6 +88,8 @@ _FILTER_ACTIVE = {
     "NodeVolumeLimits": lambda plugin, pi, snap: bool(pi.pvc_names),
     "NodeResourceTopologyMatch":
         lambda plugin, pi, snap: plugin.active_for(pi),
+    "DynamicResources":
+        lambda plugin, pi, snap: plugin.active_for(pi),
 }
 _SCORE_ACTIVE = {
     "InterPodAffinity": lambda plugin, pi, snap: bool(
@@ -302,6 +304,7 @@ class TPUBackend:
         # Vectorized NodeResourceTopologyMatch zone state, cached per
         # (snapshot generation, snapshot identity) — see _nrt_state.
         self._nrt_cache: tuple | None = None
+        self._dra_cache: tuple | None = None
         # Fixed-shape placeholder device arrays for the fused program's
         # spread slots when use_spread=False (stable jit signature).
         self._spread_dummy_cache: dict[tuple, tuple] = {}
@@ -692,6 +695,159 @@ class TPUBackend:
                     stateful_pods.add(i)
         return []
 
+    # -- DynamicResources (DRA) vectorization -------------------------------
+
+    def _dra_state(self, plugin, snapshot: Snapshot,
+                   ct: ClusterTensors) -> dict:
+        """Batch-start free-device tensors for DynamicResources: per
+        (node, class) total free counts plus, per device-attribute key,
+        the largest single-value group — enough to answer count-N claims
+        with or without a single matchAttribute constraint via numpy
+        rows instead of O(N·claims) host plugin calls. Claims are charged
+        from the allocation ledger + resident unallocated demand + the
+        assume ledger ONCE per batch (same shape as _nrt_state); in-batch
+        drift is caught by the stateful re-verify."""
+        from kubernetes_tpu.scheduler.plugins.dynamicresources import (
+            claim_allocated_node,
+            pod_claim_keys,
+        )
+        key = (ct.generation, id(snapshot), id(plugin), plugin.dra_seq,
+               plugin.assume_seq)
+        if self._dra_cache is not None and self._dra_cache[0] == key:
+            return self._dra_cache[1]
+        classes = plugin._classes()
+        class_names = sorted(classes)
+        c_index = {c: j for j, c in enumerate(class_names)}
+        N, C = ct.n_real, len(class_names)
+        free_total = np.zeros((N, C), dtype=np.int32)
+        #: attr key -> (N, C) best single-value group size
+        max_group: dict[str, np.ndarray] = {}
+
+        # One pass over the claim ledgers, grouped per node.
+        charges: dict[str, dict[str, dict]] = {}
+
+        def charge(node_name: str, claim: dict) -> None:
+            from kubernetes_tpu.api.meta import namespaced_name as nn
+            charges.setdefault(node_name, {})[nn(claim)] = claim
+
+        for n, bucket in plugin._alloc_by_node.items():
+            for claim in bucket.values():
+                charge(n, claim)
+        for ni in snapshot.nodes:
+            for pi in ni.pods:
+                for ckey in pod_claim_keys(pi):
+                    claim = plugin._claim_informer.indexer.get(ckey) \
+                        if plugin._claim_informer is not None else None
+                    if claim is not None and \
+                            claim_allocated_node(claim) is None:
+                        charge(ni.name, claim)
+        for a in plugin._assumed.values():
+            charge(a["node"], a["claim"])
+
+        attr_keys: set[str] = set()
+        per_node_free: list[list[dict]] = []
+        for idx, ni in enumerate(snapshot.nodes):
+            devices = plugin.node_devices(ni.name)  # indexed by node
+            if not devices:
+                per_node_free.append([])
+                continue
+            taken: set[str] = set()
+            for claim in (charges.get(ni.name) or {}).values():
+                alloc = (claim.get("status") or {}).get("allocation")
+                if alloc:
+                    if alloc.get("nodeName") == ni.name:
+                        taken.update(alloc.get("devices") or [])
+                    continue
+                picked = plugin._pick_devices(
+                    claim, [d for d in devices if d["name"] not in taken],
+                    classes)
+                if picked is not None:
+                    taken.update(picked)
+            free = [d for d in devices if d["name"] not in taken]
+            per_node_free.append(free)
+            for d in free:
+                attr_keys.update((d.get("attributes") or {}).keys())
+        for a in attr_keys:
+            max_group[a] = np.zeros((N, C), dtype=np.int32)
+        for idx, free in enumerate(per_node_free):
+            if not free:
+                continue
+            for j, cname in enumerate(class_names):
+                cls = classes[cname]
+                matching = [d for d in free
+                            if plugin._class_matches(cls, d)]
+                free_total[idx, j] = len(matching)
+                for a in attr_keys:
+                    groups: dict = {}
+                    for d in matching:
+                        v = (d.get("attributes") or {}).get(a)
+                        groups[v] = groups.get(v, 0) + 1
+                    if groups:
+                        max_group[a][idx, j] = max(groups.values())
+        state = {"c_index": c_index, "free_total": free_total,
+                 "max_group": max_group, "_name_idx": ct.name_to_idx}
+        self._dra_cache = (key, state)
+        return state
+
+    def _dra_filter_row(self, st: dict, plugin, pi: PodInfo,
+                        memo: dict, i: int) -> np.ndarray | None:
+        """(n_real,) bool row, or None when the pod's claims use a shape
+        the tensors can't answer (multi-attribute constraints, unknown
+        class/claim) — caller falls back to the host plugin row."""
+        hit = memo.get(i)
+        if hit is not None:
+            return hit if hit is not False else None
+        from kubernetes_tpu.scheduler.plugins.dynamicresources import (
+            claim_allocated_node,
+            claim_match_attrs,
+            claim_requests,
+            pod_claim_keys,
+        )
+        N = st["free_total"].shape[0]
+        row = np.ones((N,), dtype=np.bool_)
+        for ckey in pod_claim_keys(pi):
+            claim = plugin._claim_informer.indexer.get(ckey) \
+                if plugin._claim_informer is not None else None
+            if claim is None:
+                memo[i] = False
+                return None
+            pinned = claim_allocated_node(claim)
+            if pinned is not None:
+                pin_row = np.zeros((N,), dtype=np.bool_)
+                # restrict to the allocated node (PreFilter pinning)
+                # via positional lookup in the snapshot ordering
+                idx = st.get("_name_idx")
+                if idx is None:
+                    memo[i] = False
+                    return None
+                j = idx.get(pinned)
+                if j is not None:
+                    pin_row[j] = True
+                row &= pin_row
+                continue
+            attrs = claim_match_attrs(claim)
+            if len(attrs) > 1 or (attrs and
+                                  len(claim_requests(claim)) > 1):
+                # Multi-attribute constraints, or a claim-wide constraint
+                # spanning several requests, need whole-claim group
+                # packing — host row answers exactly.
+                memo[i] = False
+                return None
+            for req in claim_requests(claim):
+                j = st["c_index"].get(req.get("deviceClassName", ""))
+                if j is None:
+                    row[:] = False
+                    continue
+                count = int(req.get("count", 1))
+                if attrs:
+                    mg = st["max_group"].get(attrs[0])
+                    avail = mg[:, j] if mg is not None else 0
+                else:
+                    avail = st["free_total"][:, j]
+                row &= avail >= count
+        memo[i] = row
+        return row
+
     def _dynamic_filter_row(self, plugin, pi: PodInfo, snapshot: Snapshot,
                             ct: ClusterTensors,
                             state: CycleState) -> np.ndarray | None:
@@ -881,6 +1037,7 @@ class TPUBackend:
         # stateful irregular plugins (per pod, Skip-gated).
         dyn_states: dict[int, CycleState] = {}
         nrt_memo: dict[int, tuple] = {}
+        dra_memo: dict[int, object] = {}
         #: hard-spread pods deferred for template detection (see
         #: _process_spread_pods): (chunk index, PodInfo, constraints).
         spread_pods: list[tuple[int, PodInfo, list[dict]]] = []
@@ -937,6 +1094,21 @@ class TPUBackend:
                         st_nrt = self._nrt_state(plugin, snapshot, ct)
                         row = self._nrt_filter_row(st_nrt, pi, nrt_memo, i)
                         if not row.all():
+                            apply_row(plugin.NAME, i, row)
+                        stateful_pods.add(i)
+                        continue
+                    if plugin.NAME == "DynamicResources":
+                        # Vectorized claim-fit rows from batch-start free-
+                        # device tensors; in-batch consumption → stateful
+                        # re-check (over-admission is corrected there).
+                        st_dra = self._dra_state(plugin, snapshot, ct)
+                        row = self._dra_filter_row(
+                            st_dra, plugin, pi, dra_memo, i)
+                        if row is None:
+                            state = dyn_states.setdefault(i, CycleState())
+                            row = self._dynamic_filter_row(
+                                plugin, pi, snapshot, ct, state)
+                        if row is not None and not row.all():
                             apply_row(plugin.NAME, i, row)
                         stateful_pods.add(i)
                         continue

@@ -222,6 +222,22 @@ class PerfRunner:
                     # NodeResourceTopology per node, splitting allocatable
                     # across zones the way a device-manager agent reports.
                     topo = op.get("topologyTemplate")
+                    # Optional DRA inventory (SURVEY §2.3 dynamicresources):
+                    # one ResourceSlice per node listing devices with NUMA
+                    # attributes, plus the DeviceClass selecting them.
+                    dra = op.get("draTemplate")
+                    if dra:
+                        from kubernetes_tpu.api.types import (
+                            make_device_class,
+                            make_resource_slice,
+                        )
+                        cls = dra.get("className", "tpu")
+                        try:
+                            await store.create(
+                                "deviceclasses",
+                                make_device_class(cls, {"type": cls}))
+                        except Exception:
+                            pass  # already created by an earlier op
                     for i in range(count):
                         name = f"node-{node_count + i}"
                         await store.create("nodes", make_node(
@@ -233,12 +249,29 @@ class PerfRunner:
                                     name, tmpl.get("allocatable") or {},
                                     num_zones=int(topo.get("zones", 2)),
                                     devices=topo.get("devices")))
+                        if dra:
+                            zones = int(dra.get("zones", 2))
+                            per = int(dra.get("devicesPerZone", 4))
+                            devices = [
+                                {"name": f"dev-{z}-{k}",
+                                 "attributes": {"type": cls,
+                                                "numa": str(z)}}
+                                for z in range(zones) for k in range(per)]
+                            await store.create(
+                                "resourceslices",
+                                make_resource_slice(
+                                    name, dra.get("driver", "dra.ktpu"),
+                                    devices))
                     node_count += count
 
                 elif opcode == "createPods":
                     count = _resolve_count(op, params)
                     tmpl = {**DEFAULT_POD_TEMPLATE,
                             **(op.get("podTemplate") or {})}
+                    # DRA pods: podTemplate.claim stamps one ResourceClaim
+                    # per pod (the resourceclaim controller's output shape)
+                    # referenced via spec.resourceClaims.
+                    claim_tmpl = tmpl.pop("claim", None)
                     measured = bool(op.get("collectMetrics"))
                     if measured:
                         # Metric window starts now: percentiles and
@@ -255,11 +288,36 @@ class PerfRunner:
                     # boundary the benchmark). 512-wide windows let the
                     # wire transport coalesce a whole window into one
                     # multiplexed frame.
-                    for lo in range(0, count, 512):
-                        await asyncio.gather(*(
-                            store.create("pods", make_pod(
-                                name, **copy.deepcopy(tmpl)))
-                            for name in names[lo:lo + 512]))
+                    if claim_tmpl:
+                        from kubernetes_tpu.api.types import (
+                            make_resource_claim,
+                        )
+
+                        async def create_claimed(name):
+                            await store.create(
+                                "resourceclaims", make_resource_claim(
+                                    f"{name}-c0",
+                                    requests=copy.deepcopy(
+                                        claim_tmpl.get("requests") or []),
+                                    constraints=copy.deepcopy(
+                                        claim_tmpl.get("constraints")
+                                        or [])))
+                            await store.create("pods", make_pod(
+                                name, resource_claims=[{
+                                    "name": "c0",
+                                    "resourceClaimName": f"{name}-c0"}],
+                                **copy.deepcopy(tmpl)))
+
+                        for lo in range(0, count, 512):
+                            await asyncio.gather(*(
+                                create_claimed(name)
+                                for name in names[lo:lo + 512]))
+                    else:
+                        for lo in range(0, count, 512):
+                            await asyncio.gather(*(
+                                store.create("pods", make_pod(
+                                    name, **copy.deepcopy(tmpl)))
+                                for name in names[lo:lo + 512]))
                     pod_seq += count
                     created_total += count
                     if measured:

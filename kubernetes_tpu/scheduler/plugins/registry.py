@@ -24,6 +24,9 @@ from kubernetes_tpu.scheduler.plugins.noderesources import (
     NodeResourcesFit,
 )
 from kubernetes_tpu.scheduler.plugins.coscheduling import Coscheduling
+from kubernetes_tpu.scheduler.plugins.dynamicresources import (
+    DynamicResources,
+)
 from kubernetes_tpu.scheduler.plugins.noderesourcetopology import (
     NodeResourceTopologyMatch,
 )
@@ -38,6 +41,7 @@ from kubernetes_tpu.scheduler.plugins.volumebinding import (
 #: registered but not default-enabled (out-of-tree in the reference).
 IN_TREE: dict[str, Callable] = {
     "Coscheduling": Coscheduling,
+    "DynamicResources": DynamicResources,
     "NodeResourceTopologyMatch": NodeResourceTopologyMatch,
     "PrioritySort": PrioritySort,
     "SchedulingGates": SchedulingGates,
@@ -75,6 +79,7 @@ DEFAULT_PLUGINS = [
     "InterPodAffinity",
     "PodTopologySpread",
     "ImageLocality",
+    "DynamicResources",
     "DefaultPreemption",
     "DefaultBinder",
 ]

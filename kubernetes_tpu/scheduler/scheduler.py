@@ -216,7 +216,8 @@ class Scheduler:
         from kubernetes_tpu.api.meta import KIND_TO_RESOURCE
         resource_of = {k: KIND_TO_RESOURCE[k] for k in (
             "PersistentVolumeClaim", "PersistentVolume", "StorageClass",
-            "NodeResourceTopology")}
+            "NodeResourceTopology", "ResourceClaim", "ResourceSlice",
+            "DeviceClass")}
         for kind, resource in resource_of.items():
 
             def poke(action, kind=kind):
@@ -230,6 +231,8 @@ class Scheduler:
                 handlers["on_add"] = poke("Add")
             if f"{kind}/Update" in labels:
                 handlers["on_update"] = poke("Update")
+            if f"{kind}/Delete" in labels:
+                handlers["on_delete"] = poke("Delete")
             if handlers:
                 factory.informer(resource).add_event_handler(
                     ResourceEventHandler(**handlers))
@@ -502,6 +505,7 @@ class Scheduler:
         assume/Reserve/bindingCycle work is spawned as soon as its host
         verify lands, overlapping the next chunk's device solve."""
         done: set[str] = set()
+        last_t = t0
         stream = self.backend.assign_stream(pods, snapshot, fwk)
         while True:
             # Only the DEVICE step is inside the failure domain: a
@@ -535,7 +539,10 @@ class Scheduler:
                     await self._schedule_host_path(pi, live)
                     live = self.cache.update_snapshot()
                 return
-            elapsed = time.perf_counter() - t0
+            # Per-chunk delta (not since-batch-start): summed per-pod
+            # observations must track wall time, as on the pre-stream path.
+            now = time.perf_counter()
+            elapsed, last_t = now - last_t, now
             n = max(1, len(chunk_pods))
             for pi in chunk_pods:
                 done.add(pi.key)

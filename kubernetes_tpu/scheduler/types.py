@@ -83,7 +83,7 @@ class PodInfo:
         "node_name", "scheduler_name",
         "node_selector", "affinity", "tolerations",
         "topology_spread_constraints", "scheduling_gates",
-        "host_ports", "pvc_names",
+        "host_ports", "pvc_names", "resource_claims",
         "required_affinity_terms", "required_anti_affinity_terms",
         "preferred_affinity_terms", "preferred_anti_affinity_terms",
         "attempts", "last_failure", "unschedulable_plugins", "queued_at",
@@ -113,6 +113,9 @@ class PodInfo:
             v["persistentVolumeClaim"]["claimName"]
             for v in spec.get("volumes") or []
             if v.get("persistentVolumeClaim", {}).get("claimName")]
+        #: spec.resourceClaims entries (DRA): [{"name", and one of
+        #: "resourceClaimName" | "resourceClaimTemplateName"}].
+        self.resource_claims = spec.get("resourceClaims") or []
         pod_aff = self.affinity.get("podAffinity") or {}
         pod_anti = self.affinity.get("podAntiAffinity") or {}
         self.required_affinity_terms = list(

@@ -1,0 +1,302 @@
+"""DRA (dynamic resource allocation): DynamicResources plugin +
+resourceclaim controller + backend vectorization.
+
+Reference semantics mirrored: pkg/scheduler/framework/plugins/
+dynamicresources (structured parameters: scheduler-side allocation
+persisted to claim.status at PreBind), pkg/controller/resourceclaim
+(template stamping, reservedFor lifecycle, deallocation).
+"""
+
+import asyncio
+import unittest
+
+from kubernetes_tpu.api.types import (
+    make_device_class,
+    make_node,
+    make_pod,
+    make_resource_claim,
+    make_resource_claim_template,
+    make_resource_slice,
+)
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.controllers import ResourceClaimController
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def tpu_slice(node: str, zones: int = 2, per_zone: int = 4) -> dict:
+    return make_resource_slice(node, "dra.ktpu", [
+        {"name": f"dev-{z}-{k}",
+         "attributes": {"type": "tpu", "numa": str(z)}}
+        for z in range(zones) for k in range(per_zone)])
+
+
+def claim(name: str, count: int, numa_aligned: bool = True, **kw) -> dict:
+    return make_resource_claim(
+        name,
+        requests=[{"name": "tpus", "deviceClassName": "tpu",
+                   "count": count}],
+        constraints=[{"matchAttribute": "numa"}] if numa_aligned else [],
+        **kw)
+
+
+class DRAHarness:
+    """Store + scheduler (+ optional claim controller) with DRA objects."""
+
+    def __init__(self, nodes: int = 2, backend=None, controller=False):
+        self.nodes = nodes
+        self.backend = backend
+        self.controller = controller
+
+    async def __aenter__(self):
+        self.store = new_cluster_store()
+        install_core_validation(self.store)
+        await self.store.create("deviceclasses",
+                                make_device_class("tpu", {"type": "tpu"}))
+        for i in range(self.nodes):
+            await self.store.create("nodes", make_node(
+                f"n{i}", allocatable={"cpu": "16", "memory": "64Gi",
+                                      "pods": "110"}))
+            await self.store.create("resourceslices", tpu_slice(f"n{i}"))
+        self.sched = Scheduler(self.store, seed=3, backend=self.backend)
+        self.factory = InformerFactory(self.store)
+        await self.sched.setup_informers(self.factory)
+        self.rc = None
+        if self.controller:
+            self.rc = ResourceClaimController(self.store)
+            self.rc.setup(self.factory)
+        self.factory.start()
+        await self.factory.wait_for_sync()
+        if self.rc is not None:
+            self.rc.start()
+        self.run_task = asyncio.ensure_future(self.sched.run(batch_size=32))
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.sched.stop()
+        self.run_task.cancel()
+        if self.rc is not None:
+            await self.rc.stop()
+        self.factory.stop()
+        self.store.stop()
+
+    async def wait_bound(self, keys, timeout=8.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            pods = {p["metadata"]["name"]: p
+                    for p in (await self.store.list("pods")).items}
+            if all(pods.get(k, {}).get("spec", {}).get("nodeName")
+                   for k in keys):
+                return pods
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"pods not bound: {keys}")
+
+
+class TestDRAScheduling(unittest.TestCase):
+    def test_claimed_pod_schedules_and_allocation_persists(self):
+        async def body():
+            async with DRAHarness(nodes=2) as h:
+                await h.store.create("resourceclaims", claim("c1", 2))
+                await h.store.create("pods", make_pod(
+                    "p1", requests={"cpu": "1"},
+                    resource_claims=[{"name": "tpus",
+                                      "resourceClaimName": "c1"}]))
+                pods = await h.wait_bound(["p1"])
+                node = pods["p1"]["spec"]["nodeName"]
+                c = await h.store.get("resourceclaims", "default/c1")
+                alloc = c["status"]["allocation"]
+                self.assertEqual(alloc["nodeName"], node)
+                self.assertEqual(len(alloc["devices"]), 2)
+                # matchAttribute numa: both devices in one zone
+                zones = {d.split("-")[1] for d in alloc["devices"]}
+                self.assertEqual(len(zones), 1)
+                self.assertEqual(
+                    c["status"]["reservedFor"][0]["name"], "p1")
+        run(body())
+
+    def test_devices_are_finite_and_alignment_constrains(self):
+        async def body():
+            # 1 node, 2 zones x 4 devices. Aligned 3-device claims: only
+            # two fit zone-wise (3+3 leaves 1+1 free, no zone has 3).
+            async with DRAHarness(nodes=1) as h:
+                for i in range(3):
+                    await h.store.create("resourceclaims",
+                                         claim(f"c{i}", 3))
+                    await h.store.create("pods", make_pod(
+                        f"p{i}", requests={"cpu": "1"},
+                        resource_claims=[{"name": "t",
+                                          "resourceClaimName": f"c{i}"}]))
+                await h.wait_bound(["p0", "p1"])
+                await asyncio.sleep(0.3)
+                p2 = await h.store.get("pods", "default/p2")
+                self.assertFalse(p2["spec"].get("nodeName"),
+                                 "third aligned 3-TPU claim cannot fit")
+        run(body())
+
+    def test_unaligned_claim_spans_zones(self):
+        async def body():
+            async with DRAHarness(nodes=1) as h:
+                await h.store.create(
+                    "resourceclaims", claim("c6", 6, numa_aligned=False))
+                await h.store.create("pods", make_pod(
+                    "p6", requests={"cpu": "1"},
+                    resource_claims=[{"name": "t",
+                                      "resourceClaimName": "c6"}]))
+                pods = await h.wait_bound(["p6"])
+                c = await h.store.get("resourceclaims", "default/c6")
+                self.assertEqual(len(c["status"]["allocation"]["devices"]),
+                                 6)
+                self.assertTrue(pods["p6"]["spec"]["nodeName"])
+        run(body())
+
+    def test_pod_missing_claim_gates_until_claim_appears(self):
+        async def body():
+            async with DRAHarness(nodes=1) as h:
+                await h.store.create("pods", make_pod(
+                    "p1", requests={"cpu": "1"},
+                    resource_claims=[{"name": "t",
+                                      "resourceClaimName": "late"}]))
+                await asyncio.sleep(0.3)
+                p = await h.store.get("pods", "default/p1")
+                self.assertFalse(p["spec"].get("nodeName"))
+                await h.store.create("resourceclaims", claim("late", 1))
+                await h.wait_bound(["p1"])
+        run(body())
+
+    def test_batched_backend_matches_host_path(self):
+        async def body():
+            from kubernetes_tpu.ops import TPUBackend
+            async with DRAHarness(nodes=3,
+                                  backend=TPUBackend(max_batch=32)) as h:
+                # 3 nodes x 8 devices; 2-aligned claims: 12 fit total.
+                for i in range(12):
+                    await h.store.create("resourceclaims",
+                                         claim(f"c{i}", 2))
+                    await h.store.create("pods", make_pod(
+                        f"p{i}", requests={"cpu": "1"},
+                        resource_claims=[{"name": "t",
+                                          "resourceClaimName": f"c{i}"}]))
+                pods = await h.wait_bound([f"p{i}" for i in range(12)])
+                # Every allocation zone-aligned and no device double-booked.
+                used: set[tuple[str, str]] = set()
+                for i in range(12):
+                    c = await h.store.get("resourceclaims", f"default/c{i}")
+                    alloc = c["status"]["allocation"]
+                    self.assertEqual(
+                        alloc["nodeName"],
+                        pods[f"p{i}"]["spec"]["nodeName"])
+                    self.assertEqual(
+                        len({d.split("-")[1]
+                             for d in alloc["devices"]}), 1)
+                    for d in alloc["devices"]:
+                        pair = (alloc["nodeName"], d)
+                        self.assertNotIn(pair, used, "double-booked device")
+                        used.add(pair)
+                self.assertEqual(len(used), 24)
+        run(body())
+
+
+class TestPickDevices(unittest.TestCase):
+    def test_match_attribute_applies_claim_wide(self):
+        """Two requests under one matchAttribute constraint must land in
+        the SAME attribute group (reference MatchAttribute semantics) —
+        2+2 free per zone cannot satisfy two 2-device requests that must
+        agree on numa when only one zone has 4 free."""
+        from kubernetes_tpu.scheduler.plugins.dynamicresources import (
+            DynamicResources,
+        )
+        plugin = DynamicResources()
+        classes = {"tpu": make_device_class("tpu", {"type": "tpu"})}
+        c = make_resource_claim(
+            "c", requests=[
+                {"name": "a", "deviceClassName": "tpu", "count": 2},
+                {"name": "b", "deviceClassName": "tpu", "count": 2}],
+            constraints=[{"matchAttribute": "numa"}])
+        split = [  # 2 free in numa 0, 2 free in numa 1 — must NOT satisfy
+            {"name": f"dev-{z}-{k}",
+             "attributes": {"type": "tpu", "numa": str(z)}}
+            for z in range(2) for k in range(2)]
+        self.assertIsNone(plugin._pick_devices(c, split, classes))
+        one_zone = [  # 4 free in numa 1 — satisfiable, all one group
+            {"name": f"dev-1-{k}",
+             "attributes": {"type": "tpu", "numa": "1"}}
+            for k in range(4)]
+        picked = plugin._pick_devices(c, one_zone, classes)
+        self.assertEqual(len(picked), 4)
+        self.assertEqual({d.split("-")[1] for d in picked}, {"1"})
+
+
+class TestResourceClaimController(unittest.TestCase):
+    def test_template_stamping_and_e2e_lifecycle(self):
+        async def body():
+            async with DRAHarness(nodes=1, controller=True) as h:
+                await h.store.create(
+                    "resourceclaimtemplates",
+                    make_resource_claim_template(
+                        "tpu-tmpl",
+                        requests=[{"name": "t", "deviceClassName": "tpu",
+                                   "count": 4}],
+                        constraints=[{"matchAttribute": "numa"}]))
+                await h.store.create("pods", make_pod(
+                    "worker", requests={"cpu": "1"},
+                    resource_claims=[{
+                        "name": "t",
+                        "resourceClaimTemplateName": "tpu-tmpl"}]))
+                # controller stamps worker-t; scheduler allocates + binds
+                await h.wait_bound(["worker"])
+                c = await h.store.get("resourceclaims", "default/worker-t")
+                self.assertEqual(len(c["status"]["allocation"]["devices"]),
+                                 4)
+                self.assertEqual(c["metadata"]["ownerReferences"][0]["name"],
+                                 "worker")
+                # delete the pod -> controller releases + deletes the
+                # generated claim -> devices return to the pool
+                await h.store.delete("pods", "default/worker")
+                deadline = asyncio.get_event_loop().time() + 5
+                while asyncio.get_event_loop().time() < deadline:
+                    lst = await h.store.list("resourceclaims")
+                    if not lst.items:
+                        break
+                    await asyncio.sleep(0.02)
+                self.assertEqual(
+                    (await h.store.list("resourceclaims")).items, [])
+                # pool is free again: a fresh 8-device unaligned claim fits
+                await h.store.create(
+                    "resourceclaims", claim("all8", 8, numa_aligned=False))
+                await h.store.create("pods", make_pod(
+                    "big", requests={"cpu": "1"},
+                    resource_claims=[{"name": "t",
+                                      "resourceClaimName": "all8"}]))
+                await h.wait_bound(["big"])
+        run(body())
+
+    def test_user_claim_deallocates_when_consumers_drain(self):
+        async def body():
+            async with DRAHarness(nodes=1, controller=True) as h:
+                await h.store.create("resourceclaims", claim("shared", 2))
+                await h.store.create("pods", make_pod(
+                    "p1", requests={"cpu": "1"},
+                    resource_claims=[{"name": "t",
+                                      "resourceClaimName": "shared"}]))
+                await h.wait_bound(["p1"])
+                await h.store.delete("pods", "default/p1")
+                deadline = asyncio.get_event_loop().time() + 5
+                while asyncio.get_event_loop().time() < deadline:
+                    c = await h.store.get("resourceclaims",
+                                          "default/shared")
+                    if not (c.get("status") or {}).get("allocation"):
+                        break
+                    await asyncio.sleep(0.02)
+                c = await h.store.get("resourceclaims", "default/shared")
+                self.assertIsNone((c.get("status") or {}).get("allocation"))
+                self.assertEqual((c.get("status") or {}).get("reservedFor"),
+                                 [])
+        run(body())
+
+
+if __name__ == "__main__":
+    unittest.main()
