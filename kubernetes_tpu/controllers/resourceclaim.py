@@ -45,6 +45,10 @@ class ResourceClaimController(Controller):
         #: reservedFor entries by uid (a recreated same-name pod's
         #: reservation must survive the OLD pod's cleanup).
         self._deleted_uids: dict[str, str] = {}
+        #: consumer index (pod key -> claim keys naming it in reservedFor)
+        #: so release is O(pod's claims), not O(all claims).
+        self._claims_by_consumer: dict[str, set[str]] = {}
+        self._claim_consumers: dict[str, set[str]] = {}
 
     def setup(self, factory: InformerFactory) -> None:
         self.pod_informer = factory.informer("pods")
@@ -62,21 +66,58 @@ class ResourceClaimController(Controller):
 
         factory.informer("pods").add_event_handler(ResourceEventHandler(
             on_delete=remember_uid))
-        # Claim events re-sync their consumers (reservedFor names pods).
+        # Claim events re-sync their consumers (reservedFor names pods)
+        # and maintain the consumer index.
 
-        def claim_event(obj):
-            for ref in (obj.get("status") or {}).get("reservedFor") or []:
-                ns = namespace_of(obj) or "default"
-                if ref.get("name"):
-                    import asyncio
-                    asyncio.ensure_future(
-                        self.queue.add(f"{ns}/{ref['name']}"))
+        def claim_event(obj, gone=False):
+            import asyncio
+            key = namespaced_name(obj)
+            ns = namespace_of(obj) or "default"
+            new = set() if gone else {
+                f"{ns}/{r['name']}"
+                for r in (obj.get("status") or {}).get("reservedFor") or []
+                if r.get("name")}
+            old = self._claim_consumers.get(key, set())
+            for pod_key in old - new:
+                bucket = self._claims_by_consumer.get(pod_key)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        self._claims_by_consumer.pop(pod_key, None)
+            for pod_key in new - old:
+                self._claims_by_consumer.setdefault(
+                    pod_key, set()).add(key)
+            if new:
+                self._claim_consumers[key] = new
+            else:
+                self._claim_consumers.pop(key, None)
+            for pod_key in new:
+                asyncio.ensure_future(self.queue.add(pod_key))
 
+        def tmpl_arrived(obj):
+            # Pods that referenced this template before it existed parked
+            # with a warning; stamp their claims now (template creation is
+            # rare, so the pod scan here is off the hot path).
+            import asyncio
+            ns = namespace_of(obj) or "default"
+            tmpl_name = name_of(obj)
+            for pod in self.pod_informer.indexer.list():
+                if (namespace_of(pod) or "default") != ns:
+                    continue
+                for ref in (pod.get("spec") or {}) \
+                        .get("resourceClaims") or []:
+                    if ref.get("resourceClaimTemplateName") == tmpl_name:
+                        asyncio.ensure_future(
+                            self.queue.add(namespaced_name(pod)))
+                        break
+
+        factory.informer("resourceclaimtemplates").add_event_handler(
+            ResourceEventHandler(on_add=tmpl_arrived))
         factory.informer("resourceclaims").add_event_handler(
             ResourceEventHandler(
                 on_add=claim_event,
                 on_update=lambda old, new: claim_event(new),
-                on_delete=claim_event))
+                on_delete=lambda obj: claim_event(obj, gone=True)))
 
     async def sync(self, key: str) -> None:
         pod = self.pod_informer.indexer.get(key)
@@ -140,8 +181,10 @@ class ResourceClaimController(Controller):
                 return False  # some OTHER incarnation's reservation
             return True
 
-        for claim in list(self.claim_informer.indexer.list()):
-            if (namespace_of(claim) or "default") != ns:
+        claim_keys = sorted(self._claims_by_consumer.get(pod_key, ()))
+        for ck in claim_keys:
+            claim = self.claim_informer.indexer.get(ck)
+            if claim is None:
                 continue
             reserved = (claim.get("status") or {}).get("reservedFor") or []
             if not any(names_pod(r) for r in reserved):

@@ -8,7 +8,19 @@ add back as many as possible in priority order; `pickOneNodeForPreemption`
 ordering: fewest PDB violations → lowest max victim priority → smallest
 priority sum → fewest victims → latest start time).
 
-The dry-run uses cloned NodeInfo so the live snapshot is untouched.
+TPU-first (SURVEY §7 phase 6 "preemption as solve-with-victim-relaxation"):
+the candidate search is VECTORIZED over a wave. A preemption wave (a batch
+of failed high-priority pods) shares one dense tensor state — per node, the
+priority-ascending victim prefix: cumulative releasable resources, priority
+prefix sums/maxima. Per preemptor, the minimal victim count per node and
+the reference's cost ordering are numpy reductions over (N, Kmax); only
+the CHOSEN candidate is re-verified with the full host Filter chain (one
+dry-run, not N), falling back to the next-best candidate on mismatch.
+Victims claimed by earlier preemptors in the wave are excluded and the
+preemptor's own consumption is charged, so concurrent preemptors spread
+instead of stacking on one node. The reprieve subtlety (a non-resource
+filter re-admitting a mid-priority resident) is covered by the exact
+verify: on divergence the per-node host scan (`_select_victims`) answers.
 """
 
 from __future__ import annotations
@@ -17,6 +29,8 @@ import random
 
 from typing import Mapping
 
+import numpy as np
+
 from kubernetes_tpu.scheduler.framework import (
     CycleState,
     Plugin,
@@ -24,6 +38,165 @@ from kubernetes_tpu.scheduler.framework import (
     UNSCHEDULABLE_AND_UNRESOLVABLE,
 )
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+
+class _WaveState:
+    """Dense victim-relaxation tensors for one snapshot generation.
+
+    Arrays (N nodes × Kmax victim prefix × R resources):
+    - rel[n, k, r]: resources released by evicting the k+1 lowest-priority
+      unclaimed residents of node n
+    - vprio[n, k]: priority of the k-th victim (asc); INT_MAX padding
+    - vsum/vmax[n, k]: priority prefix sums / maxima
+    - used[n, r] / alloc[n, r], pods_used/alloc[n]
+    """
+
+    __slots__ = ("nodes", "resources", "r_index", "rel", "vprio", "vsum",
+                 "vmax", "vcount", "used", "alloc", "pods_used",
+                 "pods_alloc", "victims", "generation")
+
+    INF = np.iinfo(np.int64).max
+
+    def __init__(self, snapshot: Snapshot, claimed: set[str],
+                 promised: dict[str, list[dict]]):
+        nodes = list(snapshot.nodes)
+        self.nodes = nodes
+        self.generation = getattr(snapshot, "generation", None)
+        res: dict[str, None] = {}
+        for ni in nodes:
+            for r in ni.allocatable.res:
+                res.setdefault(r)
+        self.resources = list(res)
+        self.r_index = {r: j for j, r in enumerate(self.resources)}
+        N, R = len(nodes), len(self.resources)
+        kmax = 1
+        per_node: list[list[PodInfo]] = []
+        for ni in nodes:
+            cand = sorted(
+                (p for p in ni.pods if p.key not in claimed),
+                key=lambda p: (p.priority, p.key))
+            per_node.append(cand)
+            kmax = max(kmax, len(cand))
+        self.victims = per_node
+        self.rel = np.zeros((N, kmax, R), dtype=np.int64)
+        self.vprio = np.full((N, kmax), self.INF, dtype=np.int64)
+        self.vsum = np.zeros((N, kmax), dtype=np.int64)
+        self.vmax = np.zeros((N, kmax), dtype=np.int64)
+        self.vcount = np.zeros((N,), dtype=np.int64)
+        self.used = np.zeros((N, R), dtype=np.int64)
+        self.alloc = np.zeros((N, R), dtype=np.int64)
+        self.pods_used = np.zeros((N,), dtype=np.int64)
+        self.pods_alloc = np.zeros((N,), dtype=np.int64)
+        for n, ni in enumerate(nodes):
+            for r, v in ni.requested.res.items():
+                j = self.r_index.get(r)
+                if j is not None:
+                    self.used[n, j] = v
+            for r, v in ni.allocatable.res.items():
+                self.alloc[n, self.r_index[r]] = v
+            self.pods_used[n] = ni.requested.pods
+            self.pods_alloc[n] = ni.allocatable.pods
+            # Unbound-but-promised preemptors charge their target node.
+            for q, _ts in (promised.get(ni.name) or {}).values():
+                for r, v in q.items():
+                    j = self.r_index.get(r)
+                    if j is not None:
+                        self.used[n, j] += v
+                self.pods_used[n] += 1
+            cand = per_node[n]
+            self.vcount[n] = len(cand)
+            acc = np.zeros((R,), dtype=np.int64)
+            psum = 0
+            pmax = 0
+            for k, p in enumerate(cand):
+                for r, v in p.requests.items():
+                    j = self.r_index.get(r)
+                    if j is not None:
+                        acc[j] += v
+                psum += p.priority
+                pmax = max(pmax, p.priority)
+                self.rel[n, k] = acc
+                self.vprio[n, k] = p.priority
+                self.vsum[n, k] = psum
+                self.vmax[n, k] = pmax
+
+    def candidates(self, pod: PodInfo,
+                   banned: set[int]) -> list[tuple[int, int]]:
+        """[(node index, victim count)] sorted by the reference cost
+        ordering — each entry is the MINIMAL victim prefix on that node
+        that fits the pod (resources + pod count), victims restricted to
+        priorities below the preemptor's."""
+        N, kmax, R = self.rel.shape
+        q = np.zeros((R,), dtype=np.int64)
+        for r, v in pod.requests.items():
+            j = self.r_index.get(r)
+            if j is not None:
+                q[j] = v
+        # eligible[n, k]: prefix k+1 consists solely of lower-prio victims
+        eligible = self.vprio < pod.priority
+        fits = np.all(
+            self.used[:, None, :] - self.rel + q[None, None, :]
+            <= self.alloc[:, None, :], axis=-1)
+        fits &= (self.pods_used[:, None] - (np.arange(kmax)[None, :] + 1)
+                 + 1 <= self.pods_alloc[:, None])
+        ok = eligible & fits
+        any_ok = ok.any(axis=1)
+        if banned:
+            for n in banned:
+                any_ok[n] = False
+        idxs = np.nonzero(any_ok)[0]
+        if idxs.size == 0:
+            return []
+        kmin = ok[idxs].argmax(axis=1)  # first fitting prefix per node
+        vmax = self.vmax[idxs, kmin]
+        vsum = self.vsum[idxs, kmin]
+        order = np.lexsort((idxs, kmin + 1, vsum, vmax))
+        return [(int(idxs[i]), int(kmin[i]) + 1) for i in order]
+
+    def claim(self, n: int, count: int, pod: PodInfo,
+              claimed: set[str], promised: dict) -> list[PodInfo]:
+        """Commit a choice: mark victims claimed, charge the preemptor,
+        and refresh node n's tensors IN PLACE (O(K·R)) — a full rebuild
+        per preemptor made 1000-node waves O(wave² ) in python loops."""
+        import time
+        victims = self.victims[n][:count]
+        for v in victims:
+            claimed.add(v.key)
+        promised.setdefault(self.nodes[n].name, {})[pod.key] = (
+            dict(pod.requests), time.monotonic())
+        # Victims leave, the preemptor's load lands.
+        remaining = self.victims[n][count:]
+        self.victims[n] = remaining
+        for v in victims:
+            for r, val in v.requests.items():
+                j = self.r_index.get(r)
+                if j is not None:
+                    self.used[n, j] -= val
+        for r, val in pod.requests.items():
+            j = self.r_index.get(r)
+            if j is not None:
+                self.used[n, j] += val
+        self.pods_used[n] += 1 - count
+        self.rel[n] = 0
+        self.vprio[n] = self.INF
+        self.vsum[n] = 0
+        self.vmax[n] = 0
+        self.vcount[n] = len(remaining)
+        acc = np.zeros((self.rel.shape[2],), dtype=np.int64)
+        psum = 0
+        pmax = 0
+        for k, p in enumerate(remaining):
+            for r, val in p.requests.items():
+                j = self.r_index.get(r)
+                if j is not None:
+                    acc[j] += val
+            psum += p.priority
+            pmax = max(pmax, p.priority)
+            self.rel[n, k] = acc
+            self.vprio[n, k] = p.priority
+            self.vsum[n, k] = psum
+            self.vmax[n, k] = pmax
+        return list(victims)
 
 
 class DefaultPreemption(Plugin):
@@ -38,13 +211,136 @@ class DefaultPreemption(Plugin):
         self.framework = framework
         self.evict = evict
         self._rng = random.Random(self.args.get("seed", 0))
+        #: wave tensors: kept across a preemption wave with in-place claim
+        #: updates; resynced to the live snapshot on a budget (claims are
+        #: exact in-wave, external drift is caught by the live verify).
+        self._wave: _WaveState | None = None
+        self._wave_claims = 0
+        self._wave_built = 0.0
+        #: victim keys promised to earlier preemptors; pruned when the
+        #: victim is no longer resident (its deletion landed).
+        self._claimed: set[str] = set()
+        #: node name -> {preemptor pod key -> (requests, promised-at)};
+        #: entries drop when the pod binds (appears among residents), when
+        #: it re-nominates elsewhere, or on TTL (pod deleted pre-bind).
+        self._promised: dict[str, dict[str, tuple]] = {}
+        self._promised_pods: dict[str, str] = {}  # pod key -> node name
 
     def post_filter(self, state: CycleState, pod: PodInfo, snapshot: Snapshot,
                     filtered_status: Mapping[str, Status]) -> tuple[str, Status]:
         if self.framework is None:
             return "", Status.unschedulable()
+        wave = self._wave_state(snapshot)
+        banned: set[int] = set()
         # Nodes rejected as UnschedulableAndUnresolvable can't be helped by
         # preemption (preemption.go `nodesWherePreemptionMightHelp`).
+        for n, ni in enumerate(wave.nodes):
+            st = filtered_status.get(ni.name)
+            if st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                banned.add(n)
+        ranked = wave.candidates(pod, banned)
+        # Seeded tie shuffle among equal-cost leaders (the reference scans
+        # a Go map whose iteration order is randomized, which spreads
+        # concurrent preemptors across equal-cost nodes — a deterministic
+        # first-min made every preemptor in a wave nominate the SAME node
+        # and retry quadratically).
+        if len(ranked) > 1:
+            lead_cost = self._cost_of(wave, ranked[0])
+            tie_end = 1
+            while tie_end < len(ranked) and \
+                    self._cost_of(wave, ranked[tie_end]) == lead_cost:
+                tie_end += 1
+            head = ranked[:tie_end]
+            self._rng.shuffle(head)
+            ranked = head + ranked[tie_end:]
+        # Exact verify on the chosen candidate only; on divergence (a
+        # non-resource filter still failing), try the next best, then the
+        # per-node host scan.
+        for attempt, (n, count) in enumerate(ranked):
+            if attempt >= 8:
+                break
+            ni = wave.nodes[n]
+            victims = wave.victims[n][:count]
+            # Verify against the LIVE node (the wave may be a bounded-age
+            # batch view): stale-wave mis-rankings fail here and fall to
+            # the next-best candidate.
+            live_ni = snapshot.get(ni.name) or ni
+            dry = live_ni.clone()
+            for v in victims:
+                dry.remove_pod(v.key)
+            if self.framework.run_filters(
+                    state.clone(), pod, dry).is_success():
+                self._drop_promise(pod.key)  # re-nomination moves the charge
+                chosen = wave.claim(n, count, pod, self._claimed,
+                                    self._promised)
+                self._promised_pods[pod.key] = ni.name
+                self._wave_claims += 1
+                if self.evict is not None:
+                    self.evict(pod, [v.key for v in chosen], ni.name)
+                return ni.name, Status.success()
+        return self._post_filter_scan(state, pod, snapshot, filtered_status)
+
+    @staticmethod
+    def _cost_of(wave: _WaveState, entry: tuple[int, int]):
+        n, count = entry
+        return (int(wave.vmax[n, count - 1]), int(wave.vsum[n, count - 1]),
+                count)
+
+    #: resync budget: rebuild from the live snapshot after this many
+    #: claims or this much wall time, whichever first.
+    WAVE_MAX_CLAIMS = 128
+    WAVE_MAX_AGE_S = 0.5
+    #: a nominated preemptor that never binds stops being charged.
+    PROMISE_TTL_S = 30.0
+
+    def _drop_promise(self, pod_key: str) -> None:
+        node = self._promised_pods.pop(pod_key, None)
+        if node is not None:
+            entries = self._promised.get(node)
+            if entries is not None:
+                entries.pop(pod_key, None)
+                if not entries:
+                    self._promised.pop(node, None)
+
+    def _wave_state(self, snapshot: Snapshot) -> _WaveState:
+        import time
+        wave = self._wave
+        if wave is not None and len(wave.nodes) == len(snapshot.nodes) \
+                and self._wave_claims < self.WAVE_MAX_CLAIMS \
+                and time.monotonic() - self._wave_built < self.WAVE_MAX_AGE_S:
+            return wave
+        # Prune ledgers against live residency before rebuilding: a
+        # claimed victim still resident keeps its claim (delete in
+        # flight); one that vanished is done. A promised preemptor that
+        # bound is now a resident and stops being charged separately;
+        # one that never binds (deleted pre-bind) ages out on TTL.
+        resident: set[str] = set()
+        for ni in snapshot.nodes:
+            for p in ni.pods:
+                resident.add(p.key)
+        self._claimed &= resident
+        now = time.monotonic()
+        for node in list(self._promised):
+            entries = self._promised[node]
+            for pk in list(entries):
+                _reqs, ts = entries[pk]
+                if pk in resident or now - ts > self.PROMISE_TTL_S:
+                    entries.pop(pk, None)
+                    self._promised_pods.pop(pk, None)
+            if not entries:
+                self._promised.pop(node, None)
+        wave = _WaveState(snapshot, self._claimed, self._promised)
+        self._wave = wave
+        self._wave_claims = 0
+        self._wave_built = time.monotonic()
+        return wave
+
+    # -- legacy exact scan (fallback + differential reference) -------------
+
+    def _post_filter_scan(self, state: CycleState, pod: PodInfo,
+                          snapshot: Snapshot,
+                          filtered_status: Mapping[str, Status]
+                          ) -> tuple[str, Status]:
         candidates: list[tuple[str, list[PodInfo]]] = []
         for node in snapshot:
             st = filtered_status.get(node.name)
@@ -56,7 +352,15 @@ class DefaultPreemption(Plugin):
         if not candidates:
             return "", Status.unschedulable(
                 "preemption: 0/%d nodes are available" % len(snapshot))
+        import time
         node_name, victims = self._pick_one(candidates)
+        for v in victims:
+            self._claimed.add(v.key)
+        self._drop_promise(pod.key)
+        self._promised.setdefault(node_name, {})[pod.key] = (
+            dict(pod.requests), time.monotonic())
+        self._promised_pods[pod.key] = node_name
+        self._wave = None
         if self.evict is not None:
             self.evict(pod, [v.key for v in victims], node_name)
         return node_name, Status.success()
@@ -65,7 +369,8 @@ class DefaultPreemption(Plugin):
                         node: NodeInfo) -> list[PodInfo] | None:
         """Dry-run: remove ALL lower-priority pods; if pod fits, add back as
         many as possible (highest priority first), keeping feasibility."""
-        lower = [p for p in node.pods if p.priority < pod.priority]
+        lower = [p for p in node.pods
+                 if p.priority < pod.priority and p.key not in self._claimed]
         if not lower:
             return None
         dry = node.clone()
@@ -86,11 +391,7 @@ class DefaultPreemption(Plugin):
     def _pick_one(self, candidates: list[tuple[str, list[PodInfo]]]
                   ) -> tuple[str, list[PodInfo]]:
         """pickOneNodeForPreemption cost ordering (no PDB tier yet —
-        disruption controller integration adds it). Ties break RANDOMLY
-        (seeded): the reference scans a Go map whose iteration order is
-        randomized, which spreads concurrent preemptors across equal-cost
-        nodes — a deterministic first-min made every preemptor in a wave
-        nominate the SAME node and retry quadratically."""
+        disruption controller integration adds it)."""
         def cost(entry):
             _, victims = entry
             return (

@@ -370,10 +370,10 @@ class DynamicResources(Plugin):
                     f"resource claim {key} not found")
             node = claim_allocated_node(claim)
             if node is not None:
-                reserved = {r.get("name")
-                            for r in (claim.get("status") or {})
-                            .get("reservedFor") or []}
-                if pod.name not in reserved and pinned not in (None, node):
+                if pinned is not None and pinned != node:
+                    # Two claims hold devices on different nodes: no node
+                    # can satisfy both — unresolvable until one
+                    # deallocates, NOT a retry loop.
                     return Status.unschedulable(
                         "claims allocated on different nodes")
                 pinned = node
