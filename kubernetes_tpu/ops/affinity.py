@@ -18,8 +18,11 @@ template-derived workloads, so per-term cost is a (N×U) matvec — far below
 one device dispatch. The resulting (P,N) mask feeds the XLA solver; parity
 with the host plugin is differential-tested (tests/test_affinity_tensor.py).
 
-Unsupported shape → per-pod host fallback (namespaceSelector in terms; the
-host plugin models the nil case only, same as us — kept symmetrical).
+namespaceSelector terms resolve to explicit namespace sets through the
+InterPodAffinity plugin's NamespaceResolver (the reference's PreFilter
+namespace merge) — same label algebra, just a wider namespace tuple in
+the interned-count keys. Without a resolver those terms route to the
+per-pod host fallback.
 """
 
 from __future__ import annotations
@@ -37,14 +40,21 @@ def _seg_sum(values: np.ndarray, ids: np.ndarray, num: int) -> np.ndarray:
     return out
 
 
-def _term_ns(term: dict, owner_ns: str) -> tuple[str, ...]:
+def _term_ns(term: dict, owner_ns: str, resolver=None) -> tuple[str, ...]:
+    if resolver is not None and term.get("namespaceSelector") is not None:
+        return resolver(term, owner_ns)
     return tuple(term.get("namespaces") or [owner_ns])
 
 
 class AffinityCompiler:
-    """Per-snapshot compiled state for batched affinity filtering."""
+    """Per-snapshot compiled state for batched affinity filtering.
 
-    def __init__(self, snapshot: Snapshot, n_pad: int):
+    `ns_resolver` (plugins.interpodaffinity.NamespaceResolver) resolves
+    namespaceSelector terms to explicit namespace sets; without one those
+    terms route to the host fallback (supported() returns False)."""
+
+    def __init__(self, snapshot: Snapshot, n_pad: int, ns_resolver=None):
+        self.ns_resolver = ns_resolver
         self.snapshot = snapshot
         self.n_pad = n_pad
         self.n_real = len(snapshot.nodes)
@@ -75,7 +85,7 @@ class AffinityCompiler:
 
         def _carrier(term: dict, ns: str, n: int, w: float,
                      is_hard: bool = False) -> None:
-            if term.get("namespaceSelector"):
+            if term.get("namespaceSelector") and ns_resolver is None:
                 self.score_ns_unsupported = True
                 return
             key = repr((term, ns, is_hard))
@@ -127,8 +137,9 @@ class AffinityCompiler:
 
     # -- per-term masks (cached by term signature) -------------------------
 
-    @staticmethod
-    def supported(pod: PodInfo) -> bool:
+    def supported(self, pod: PodInfo) -> bool:
+        if self.ns_resolver is not None:
+            return True  # namespaceSelector terms resolve to explicit sets
         terms = (pod.required_affinity_terms
                  + pod.required_anti_affinity_terms)
         return not any(t.get("namespaceSelector") for t in terms)
@@ -138,7 +149,7 @@ class AffinityCompiler:
         m = self._mask_cache.get(key)
         if m is None:
             counts = self.counts_for(term.get("labelSelector"),
-                                     _term_ns(term, owner_ns))
+                                     _term_ns(term, owner_ns, self.ns_resolver))
             per_node, has_key = self._domain_presence(
                 counts, term.get("topologyKey", ""))
             m = ~has_key | (per_node == 0)
@@ -152,7 +163,7 @@ class AffinityCompiler:
         got = self._mask_cache.get(key)
         if got is None:
             counts = self.counts_for(term.get("labelSelector"),
-                                     _term_ns(term, owner_ns))
+                                     _term_ns(term, owner_ns, self.ns_resolver))
             tk = term.get("topologyKey", "")
             per_node, has_key = self._domain_presence(counts, tk)
             # `total` drives the first-pod-in-group escape: the host plugin
@@ -176,7 +187,7 @@ class AffinityCompiler:
             mk = (key, pod_sig)
             hit = self._sym_match_cache.get(mk)
             if hit is None:
-                nses = _term_ns(term, owner_ns)
+                nses = _term_ns(term, owner_ns, self.ns_resolver)
                 hit = pod.namespace in nses and from_label_selector(
                     term.get("labelSelector")).matches(pod.labels)
                 self._sym_match_cache[mk] = hit
@@ -218,8 +229,11 @@ class AffinityCompiler:
         return row
 
     def score_supported(self, pod: PodInfo) -> bool:
-        """namespaceSelector needs per-namespace label matching the interned
-        tables don't model — those pods take the host score path."""
+        """Without a namespace resolver, namespaceSelector terms need
+        per-namespace label matching the interned tables don't model —
+        those pods take the host score path."""
+        if self.ns_resolver is not None:
+            return True
         if self.score_ns_unsupported:
             return False
         return not any(
@@ -251,7 +265,7 @@ class AffinityCompiler:
         for term in pod.preferred_affinity_terms:
             t = term.get("podAffinityTerm") or {}
             counts = self.counts_for(t.get("labelSelector"),
-                                     _term_ns(t, pod.namespace))
+                                     _term_ns(t, pod.namespace, self.ns_resolver))
             per_node, has_key = self._masked_presence(
                 counts, t.get("topologyKey", ""), feasible)
             row += float(term.get("weight", 1)) * np.where(
@@ -259,7 +273,7 @@ class AffinityCompiler:
         for term in pod.preferred_anti_affinity_terms:
             t = term.get("podAffinityTerm") or {}
             counts = self.counts_for(t.get("labelSelector"),
-                                     _term_ns(t, pod.namespace))
+                                     _term_ns(t, pod.namespace, self.ns_resolver))
             per_node, has_key = self._masked_presence(
                 counts, t.get("topologyKey", ""), feasible)
             row -= float(term.get("weight", 1)) * np.where(
@@ -271,7 +285,7 @@ class AffinityCompiler:
             mk = ("score", key, pod_sig)
             hit = self._sym_match_cache.get(mk)
             if hit is None:
-                nses = _term_ns(term, owner_ns)
+                nses = _term_ns(term, owner_ns, self.ns_resolver)
                 hit = pod.namespace in nses and from_label_selector(
                     term.get("labelSelector")).matches(pod.labels)
                 self._sym_match_cache[mk] = hit
@@ -287,7 +301,7 @@ class AffinityCompiler:
     def _self_matches(self, pod: PodInfo) -> bool:
         from kubernetes_tpu.api.labels import from_label_selector
         for t in pod.required_affinity_terms:
-            if pod.namespace not in _term_ns(t, pod.namespace):
+            if pod.namespace not in _term_ns(t, pod.namespace, self.ns_resolver):
                 return False
             if not from_label_selector(t.get("labelSelector")).matches(pod.labels):
                 return False

@@ -336,10 +336,18 @@ class TPUBackend:
         return self._ct
 
     def _affinity_compiler(self, snapshot: Snapshot, ct: ClusterTensors):
-        if getattr(self, "_affinity", None) is None:
+        resolver = getattr(self, "_ns_resolver", None)
+        epoch = resolver.epoch if resolver is not None else -1
+        cached = getattr(self, "_affinity", None)
+        if cached is not None and \
+                getattr(self, "_affinity_ns_epoch", -1) != epoch:
+            cached = None  # namespace relabel: resolved sets are stale
+        if cached is None:
             from kubernetes_tpu.ops.affinity import AffinityCompiler
-            self._affinity = AffinityCompiler(snapshot, ct.n_pad)
-        return self._affinity
+            cached = self._affinity = AffinityCompiler(
+                snapshot, ct.n_pad, ns_resolver=resolver)
+            self._affinity_ns_epoch = epoch
+        return cached
 
     # -- NodeResourceTopologyMatch vectorization (BASELINE config #4) -----
 
@@ -922,6 +930,11 @@ class TPUBackend:
                fwk: Framework) -> "_AssignCtx":
         ct = self._tensors(snapshot)
         pods = list(pods)
+        # namespaceSelector terms resolve through the framework's
+        # InterPodAffinity plugin (its namespaces informer).
+        ipa = next((p for p in fwk.plugins
+                    if p.NAME == "InterPodAffinity"), None)
+        self._ns_resolver = getattr(ipa, "ns_resolver", None)
         ctx = _AssignCtx()
         ctx.snapshot, ctx.fwk, ctx.ct = snapshot, fwk, ct
         ctx.chunks = [pods[lo:lo + self.max_batch]
@@ -933,6 +946,8 @@ class TPUBackend:
         ctx.delta = []
         ctx.delta_has_terms = False
         ctx.sel_cache = {}
+        ctx.delta_idx = _DeltaAffinityIndex(ctx.sel_cache,
+                                            self._ns_resolver)
         ctx.wsnap = None
         # Device-side PodTopologySpread template (homogeneous batches):
         # built lazily by _process_spread_pods; poisoned = fall back to
@@ -1158,18 +1173,28 @@ class TPUBackend:
             return host_scores
 
         def feasible_idx(i: int) -> np.ndarray:
+            # Class-level masks: one row per DISTINCT request/toleration
+            # shape (equivalence classes), not per pod — the (P,N,R)
+            # broadcast was a top host cost for score-bearing families.
             nonlocal fit_np, taint_np
             if fit_np is None:
-                fit_np = self._numpy_fit_mask(ct, batch)
+                uq = np.stack(batch.req_rows)  # (n_classes, R)
+                fit_np = np.all(
+                    ct.used_q[None, :, :] + uq[:, None, :]
+                    <= ct.alloc_q[None, :, :], axis=-1)
+                fit_np &= (ct.used_pods + 1 <= ct.alloc_pods)[None, :]
                 if "TaintToleration" in filter_names:
-                    taint_np = (batch.untol_filter.astype(np.int32)
+                    ut = np.stack(batch.untol_rows)
+                    taint_np = (ut.astype(np.int32)
                                 @ ct.taint_filter_mat.T.astype(np.int32)) == 0
                 else:
                     taint_np = np.ones(
-                        (P, ct.taint_filter_mat.shape[0]), dtype=np.bool_)
-            feas = fit_np[i, : ct.n_real] & taint_np[i, : ct.n_real]
+                        (len(batch.untol_rows),
+                         ct.taint_filter_mat.shape[0]), dtype=np.bool_)
+            feas = fit_np[batch.req_class[i], : ct.n_real] \
+                & taint_np[batch.untol_class[i], : ct.n_real]
             if static_mask is not None:
-                feas &= static_mask[i, : ct.n_real]
+                feas = feas & static_mask[i, : ct.n_real]
             return np.nonzero(feas)[0]
 
         for name, plugin in score_plugins.items():
@@ -1584,7 +1609,7 @@ class TPUBackend:
                     continue
             elif delta_has_terms or pi.has_affinity_constraints:
                 if not _delta_affinity_ok(pi, ni, delta, ct, compiler,
-                                          sel_cache):
+                                          sel_cache, ctx.delta_idx):
                     assignments[pi.key] = None
                     diagnostics[pi.key] = {ni.name: affinity_conflict}
                     rejects.append((i, idx))
@@ -1601,19 +1626,13 @@ class TPUBackend:
                         ctx.wsnap.have_pods_with_required_anti_affinity:
                     ctx.wsnap.have_pods_with_required_anti_affinity.append(ni)
             delta.append((pi, ni.labels))
+            ctx.delta_idx.add(pi, ni.labels)
             if pi.required_affinity_terms or pi.required_anti_affinity_terms:
                 delta_has_terms = True
         ctx.delta_has_terms = delta_has_terms
         return rejects
 
     # -- explainability ------------------------------------------------------
-
-    def _numpy_fit_mask(self, ct: ClusterTensors, batch: PodBatch) -> np.ndarray:
-        res_ok = np.all(
-            ct.used_q[None, :, :] + batch.req_q[:, None, :]
-            <= ct.alloc_q[None, :, :], axis=-1)
-        pods_ok = (ct.used_pods + 1 <= ct.alloc_pods)[None, :]
-        return res_ok & pods_ok
 
     def _build_diagnostics(self, idxs, pods, ct, batch, fit0, taint_ok,
                            host_filter_fail, filter_names, diagnostics,
@@ -1685,30 +1704,151 @@ class _AssignCtx:
     __slots__ = ("snapshot", "fwk", "ct", "chunks", "params",
                  "assignments", "diagnostics",
                  "working", "delta", "delta_has_terms", "sel_cache",
-                 "wsnap", "spread", "spread_poisoned")
+                 "delta_idx", "wsnap", "spread", "spread_poisoned")
 
 
-def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict):
+def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict,
+                    resolver=None):
     """Compiled (namespace-set, Selector) per unique term — the delta loop
     is O(batch²) pairs, so per-pair selector re-parsing would dominate."""
     key = (id(term), owner_ns)
     got = sel_cache.get(key)
     if got is None:
         from kubernetes_tpu.api.labels import from_label_selector
-        nses = frozenset(term.get("namespaces") or [owner_ns])
+        if resolver is not None and \
+                term.get("namespaceSelector") is not None:
+            nses = frozenset(resolver(term, owner_ns))
+        else:
+            nses = frozenset(term.get("namespaces") or [owner_ns])
         got = sel_cache[key] = (nses, from_label_selector(
             term.get("labelSelector")))
     return got
 
 
-def _delta_affinity_ok(pi, ni, delta, ct, compiler, sel_cache) -> bool:
+def _term_sig(term: dict, owner_ns: str, sel_cache: dict) -> tuple:
+    """CONTENT-keyed term signature: pods stamped from one template carry
+    equal-but-distinct term dicts, so id()-keyed indexes would grow one
+    entry per pod and make delta maintenance O(batch) again."""
+    key = ("sig", id(term), owner_ns)
+    sig = sel_cache.get(key)
+    if sig is None:
+        sig = sel_cache[key] = (
+            term.get("topologyKey", ""),
+            tuple(sorted(term.get("namespaces") or [owner_ns])),
+            repr(term.get("namespaceSelector")),
+            repr(term.get("labelSelector")))
+    return sig
+
+
+class _DeltaAffinityIndex:
+    """Incremental index over same-batch placements, answering the three
+    delta-affinity questions in O(terms) per query instead of O(|delta|):
+
+    - fwd[sig]: for a queried term, count of delta pods matching its
+      selector, grouped by their NODE's topology value.
+    - anti[sig]: for anti-affinity terms CARRIED BY delta pods, the same
+      node-topology-value counts (symmetry: they forbid the querier).
+
+    add() is O(registered signatures) per accepted pod — one per distinct
+    template in the batch, not one per pod."""
+
+    __slots__ = ("sel_cache", "fwd", "anti", "resolver")
+
+    def __init__(self, sel_cache: dict, resolver=None):
+        self.sel_cache = sel_cache
+        self.resolver = resolver
+        #: sig -> [nses, sel, tk, {node tk value -> count}, total]
+        self.fwd: dict[tuple, list] = {}
+        self.anti: dict[tuple, list] = {}
+
+    def register(self, term: dict, owner_ns: str, delta: list) -> list:
+        sig = _term_sig(term, owner_ns, self.sel_cache)
+        e = self.fwd.get(sig)
+        if e is None:
+            nses, sel = _cached_matcher(term, owner_ns, self.sel_cache,
+                                        self.resolver)
+            tk = term.get("topologyKey", "")
+            counts: dict = {}
+            total = 0
+            for d, labels_m in delta:  # back-fill placements so far
+                if d.namespace in nses and sel.matches(d.labels):
+                    v = labels_m.get(tk)
+                    counts[v] = counts.get(v, 0) + 1
+                    total += 1
+            e = self.fwd[sig] = [nses, sel, tk, counts, total]
+        return e
+
+    def add(self, d, node_labels: Mapping) -> None:
+        for e in self.fwd.values():
+            nses, sel, tk, counts, _total = e
+            if d.namespace in nses and sel.matches(d.labels):
+                v = node_labels.get(tk)
+                counts[v] = counts.get(v, 0) + 1
+                e[4] += 1
+        for term in d.required_anti_affinity_terms:
+            sig = _term_sig(term, d.namespace, self.sel_cache)
+            e = self.anti.get(sig)
+            if e is None:
+                nses, sel = _cached_matcher(
+                    term, d.namespace, self.sel_cache, self.resolver)
+                e = self.anti[sig] = [
+                    nses, sel, term.get("topologyKey", ""), {}, 0]
+            v = node_labels.get(e[2])
+            e[3][v] = e[3].get(v, 0) + 1
+            e[4] += 1
+
+
+def _delta_affinity_ok(pi, ni, delta, ct, compiler, sel_cache,
+                       delta_idx: "_DeltaAffinityIndex | None" = None) -> bool:
     """Inter-pod affinity check of `pi` on node `ni` against only the pods
     placed earlier in this batch (the batch-start tensor rows already cover
-    the snapshot exactly)."""
+    the snapshot exactly). With a `_DeltaAffinityIndex` the three checks
+    are O(terms) dictionary lookups; the list-walk fallback remains for
+    callers without one."""
     labels_n = ni.labels
 
+    if delta_idx is not None:
+        # (1) pi's own anti-affinity vs delta placements.
+        for term in pi.required_anti_affinity_terms:
+            e = delta_idx.register(term, pi.namespace, delta)
+            tv = labels_n.get(e[2])
+            if tv is not None and e[3].get(tv):
+                return False
+        # (2) symmetry: delta pods' anti-affinity vs pi.
+        for e in delta_idx.anti.values():
+            nses, sel, tk, counts, _total = e
+            tv = labels_n.get(tk)
+            if tv is not None and counts.get(tv) \
+                    and pi.namespace in nses and sel.matches(pi.labels):
+                return False
+        # (3) pi's required affinity: delta pods can only ADD matches; the
+        # one invalidation is the first-pod-in-group escape — once a
+        # matching pod exists (placed in this batch), the term must be
+        # satisfied in n's domain for real.
+        for term in pi.required_affinity_terms:
+            tk = term.get("topologyKey", "")
+            tv = labels_n.get(tk)
+            if tv is None:
+                return False
+            e = delta_idx.register(term, pi.namespace, delta)
+            if e[3].get(tv):
+                continue  # satisfied by a batch sibling in this domain
+            if compiler is not None:
+                per_node, _, total = compiler.affinity_term_presence(
+                    term, pi.namespace)
+                idx = ct.name_to_idx.get(ni.name)
+                if idx is not None and per_node[idx] > 0:
+                    continue  # satisfied by the snapshot already
+                if total == 0 and e[4] == 0:
+                    continue  # escape still valid: no match anywhere
+                return False
+            if e[4]:
+                return False
+        return True
+
     def matches(term, owner_ns, other) -> bool:
-        nses, sel = _cached_matcher(term, owner_ns, sel_cache)
+        nses, sel = _cached_matcher(term, owner_ns, sel_cache,
+                                    getattr(compiler, "ns_resolver", None))
         return other.namespace in nses and sel.matches(other.labels)
 
     # (1) pi's own anti-affinity vs delta placements.

@@ -262,22 +262,33 @@ class PodBatch:
         # Row vectors cached by signature: workload pods come from
         # templates (the reference's equivalence-class observation), so
         # distinct request shapes / toleration lists are few per batch.
-        tol_cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
-        req_cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        tol_cache: dict[str, tuple[int, np.ndarray, np.ndarray]] = {}
+        req_cache: dict[str, tuple[int, np.ndarray, np.ndarray]] = {}
+        #: per-pod equivalence-class ids (index into the unique-row lists)
+        #: — class-level host masks replace (P,N) broadcasts downstream.
+        self.req_class = np.zeros((P,), dtype=np.int32)
+        self.untol_class = np.zeros((P,), dtype=np.int32)
+        self.req_rows: list[np.ndarray] = []
+        self.untol_rows: list[np.ndarray] = []
         for i, pi in enumerate(pods):
             rsig = repr(pi.requests) + "|" + repr(pi.nonzero_requests)
             rows = req_cache.get(rsig)
             if rows is None:
-                rows = req_cache[rsig] = ct.quantize_requests(
+                q, qnz = ct.quantize_requests(
                     pi.requests, pi.nonzero_requests)
-            self.req_q[i], self.req_nz_q[i] = rows
+                rows = req_cache[rsig] = (len(self.req_rows), q, qnz)
+                self.req_rows.append(q)
+            cls, self.req_q[i], self.req_nz_q[i] = rows
+            self.req_class[i] = cls
             sig = repr(pi.tolerations)
             cached = tol_cache.get(sig)
             if cached is None:
-                cached = (ct.taints.untolerated(pi.tolerations, "filter"),
-                          ct.taints.untolerated(pi.tolerations, "prefer"))
-                tol_cache[sig] = cached
-            self.untol_filter[i], self.untol_prefer[i] = cached
+                uf = ct.taints.untolerated(pi.tolerations, "filter")
+                up = ct.taints.untolerated(pi.tolerations, "prefer")
+                cached = tol_cache[sig] = (len(self.untol_rows), uf, up)
+                self.untol_rows.append(uf)
+            tcls, self.untol_filter[i], self.untol_prefer[i] = cached
+            self.untol_class[i] = tcls
         # Padding pods: no requests, all-false masks are applied by the
         # backend (their base mask row is zero), so they never get assigned.
         self.p_real = len(pods)

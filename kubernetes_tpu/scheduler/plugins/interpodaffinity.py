@@ -17,9 +17,11 @@ Parity target: pkg/scheduler/framework/plugins/interpodaffinity/
 - Score: preferred terms weighted sum, plus symmetry (existing pods'
   preferred anti/affinity terms about the incoming pod).
 
-Namespace semantics: a term matches pods in the term's `namespaces` list, or
-the incoming pod's own namespace when unset (namespaceSelector is modeled for
-the common nil case only).
+Namespace semantics: a term matches pods in the term's `namespaces` list
+∪ the namespaces selected by its `namespaceSelector` (resolved against the
+namespaces informer — the reference's GetNamespaceLabelsSnapshot merge in
+PreFilter), or the owner pod's namespace when both are unset. An empty
+namespaceSelector ({}) selects every namespace.
 """
 
 from __future__ import annotations
@@ -39,9 +41,61 @@ from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
 _STATE_KEY = "PreFilterInterPodAffinity"
 
 
-def _term_matches(term: Mapping, pod_ns: str, other: PodInfo) -> bool:
+class NamespaceResolver:
+    """Resolves an affinity term's effective namespace set, including
+    `namespaceSelector` terms, against the live Namespace objects.
+
+    Memoized per (selector, explicit namespaces) and invalidated when any
+    namespace changes (epoch). Callable: resolver(term, owner_ns) ->
+    tuple of namespace names."""
+
+    def __init__(self):
+        self._informer = None
+        self._epoch = 0
+        self._memo: dict = {}
+
+    def wire(self, factory) -> None:
+        from kubernetes_tpu.client import ResourceEventHandler
+        self._informer = factory.informer("namespaces")
+
+        def bump(*_a):
+            self._epoch += 1
+            self._memo.clear()
+
+        self._informer.add_event_handler(ResourceEventHandler(
+            on_add=bump, on_update=lambda old, new: bump(),
+            on_delete=bump))
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def __call__(self, term: Mapping, owner_ns: str) -> tuple[str, ...]:
+        ns_sel = term.get("namespaceSelector")
+        explicit = term.get("namespaces") or []
+        if ns_sel is None:
+            return tuple(explicit) if explicit else (owner_ns,)
+        key = (repr(ns_sel), tuple(explicit))
+        got = self._memo.get(key)
+        if got is None:
+            names = set(explicit)
+            if self._informer is not None:
+                sel = from_label_selector(ns_sel)
+                for ns_obj in self._informer.indexer.list():
+                    labels = ns_obj.get("metadata", {}).get("labels") or {}
+                    if sel.matches(labels):
+                        names.add(ns_obj["metadata"]["name"])
+            got = self._memo[key] = tuple(sorted(names))
+        return got
+
+
+def _term_matches(term: Mapping, pod_ns: str, other: PodInfo,
+                  resolver=None) -> bool:
     """Does `other` match an affinity term owned by a pod in `pod_ns`?"""
-    namespaces = term.get("namespaces") or [pod_ns]
+    if resolver is not None:
+        namespaces = resolver(term, pod_ns)
+    else:
+        namespaces = term.get("namespaces") or [pod_ns]
     if other.namespace not in namespaces:
         return False
     return from_label_selector(term.get("labelSelector")).matches(other.labels)
@@ -70,6 +124,13 @@ class InterPodAffinity(Plugin):
         super().__init__(args)
         self.hard_pod_affinity_weight = int(
             self.args.get("hardPodAffinityWeight", 1))
+        #: namespaceSelector resolution (reference PreFilter namespace
+        #: merge); works informer-less too (selector terms then match
+        #: only their explicit namespaces).
+        self.ns_resolver = NamespaceResolver()
+
+    def set_informers(self, factory) -> None:
+        self.ns_resolver.wire(factory)
 
     # -- PreFilter ---------------------------------------------------------
 
@@ -86,19 +147,19 @@ class InterPodAffinity(Plugin):
                 for term in pod.required_affinity_terms:
                     tk = term.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(term, pod.namespace, existing):
+                    if tv is not None and _term_matches(term, pod.namespace, existing, self.ns_resolver):
                         s.affinity_counts[(tk, tv)] += 1
                 for term in pod.required_anti_affinity_terms:
                     tk = term.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(term, pod.namespace, existing):
+                    if tv is not None and _term_matches(term, pod.namespace, existing, self.ns_resolver):
                         s.anti_affinity_counts[(tk, tv)] += 1
             # Symmetry: existing pods' required anti-affinity vs incoming pod.
             for existing in node.pods_with_required_anti_affinity:
                 for term in existing.required_anti_affinity_terms:
                     tk = term.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(term, existing.namespace, pod):
+                    if tv is not None and _term_matches(term, existing.namespace, pod, self.ns_resolver):
                         s.existing_anti_counts[(tk, tv)] += 1
         state.write(_STATE_KEY, s)
         return Status.success()
@@ -133,7 +194,7 @@ class InterPodAffinity(Plugin):
                 # pod matches its own terms (first-pod-in-group rule,
                 # filtering.go `satisfyPodAffinity` nomatchingexists check).
                 if not any(s.affinity_counts.values()) and all(
-                    _term_matches(t, pod.namespace, pod)
+                    _term_matches(t, pod.namespace, pod, self.ns_resolver)
                     for t in pod.required_affinity_terms
                 ):
                     continue
@@ -156,13 +217,13 @@ class InterPodAffinity(Plugin):
                     t = term.get("podAffinityTerm") or {}
                     tk = t.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(t, pod.namespace, existing):
+                    if tv is not None and _term_matches(t, pod.namespace, existing, self.ns_resolver):
                         scores[(tk, tv)] += term.get("weight", 1)
                 for term in pod.preferred_anti_affinity_terms:
                     t = term.get("podAffinityTerm") or {}
                     tk = t.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(t, pod.namespace, existing):
+                    if tv is not None and _term_matches(t, pod.namespace, existing, self.ns_resolver):
                         scores[(tk, tv)] -= term.get("weight", 1)
             # Symmetry: existing pods' preferred terms about the incoming pod.
             for existing in node.pods_with_affinity:
@@ -170,19 +231,19 @@ class InterPodAffinity(Plugin):
                     t = term.get("podAffinityTerm") or {}
                     tk = t.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(t, existing.namespace, pod):
+                    if tv is not None and _term_matches(t, existing.namespace, pod, self.ns_resolver):
                         scores[(tk, tv)] += term.get("weight", 1)
                 for term in existing.preferred_anti_affinity_terms:
                     t = term.get("podAffinityTerm") or {}
                     tk = t.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(t, existing.namespace, pod):
+                    if tv is not None and _term_matches(t, existing.namespace, pod, self.ns_resolver):
                         scores[(tk, tv)] -= term.get("weight", 1)
                 # Hard-affinity symmetry weighted by hardPodAffinityWeight.
                 for t in existing.required_affinity_terms:
                     tk = t.get("topologyKey", "")
                     tv = node.labels.get(tk)
-                    if tv is not None and _term_matches(t, existing.namespace, pod):
+                    if tv is not None and _term_matches(t, existing.namespace, pod, self.ns_resolver):
                         scores[(tk, tv)] += self.hard_pod_affinity_weight
         state.write(_STATE_KEY + "/score", dict(scores))
         return Status.success()

@@ -246,3 +246,93 @@ class TestDeviceSpreadScan:
                 t_counts[zone_of[node]] += 1
         assert max(s_counts.values()) - min(s_counts.values()) <= 1
         assert max(t_counts.values()) - min(t_counts.values()) <= 2
+
+
+class _FakeNsInformer:
+    """indexer.list() over static Namespace objects."""
+
+    class _Idx:
+        def __init__(self, items):
+            self._items = items
+
+        def list(self):
+            return self._items
+
+    def __init__(self, namespaces: dict[str, dict]):
+        self.indexer = self._Idx([
+            {"metadata": {"name": n, "labels": labels}}
+            for n, labels in namespaces.items()])
+
+    def add_event_handler(self, h):
+        pass
+
+
+def resolver_for(namespaces: dict[str, dict]):
+    from kubernetes_tpu.scheduler.plugins.interpodaffinity import (
+        NamespaceResolver,
+    )
+    r = NamespaceResolver()
+    r._informer = _FakeNsInformer(namespaces)
+    return r
+
+
+class TestNamespaceSelector:
+    """namespaceSelector terms: resolver semantics + host/tensor parity
+    (reference: PreFilter's GetNamespaceLabelsSnapshot merge)."""
+
+    NAMESPACES = {"default": {"team": "a"}, "other": {"team": "b"},
+                  "third": {"team": "a"}}
+
+    def ns_term(self, app, key, ns_sel):
+        return {"labelSelector": {"matchLabels": {"app": app}},
+                "topologyKey": key, "namespaceSelector": ns_sel}
+
+    def test_resolver_semantics(self):
+        r = resolver_for(self.NAMESPACES)
+        t = self.ns_term("web", ZONE, {"matchLabels": {"team": "a"}})
+        assert r(t, "default") == ("default", "third")
+        # empty selector ({}) matches every namespace
+        t_all = self.ns_term("web", ZONE, {})
+        assert r(t_all, "default") == ("default", "other", "third")
+        # explicit namespaces union with the selector's matches
+        t_union = dict(t, namespaces=["other"])
+        assert r(t_union, "default") == ("default", "other", "third")
+        # nil selector: explicit list or owner namespace
+        plain = {"labelSelector": {}, "topologyKey": ZONE}
+        assert r(plain, "default") == ("default",)
+
+    def test_host_and_tensor_parity_with_ns_selector(self):
+        plugin = InterPodAffinity()
+        plugin.ns_resolver = resolver_for(self.NAMESPACES)
+        for seed in range(4):
+            rng = random.Random(1000 + seed)
+            snapshot = random_affinity_cluster(rng)
+            compiler = AffinityCompiler(
+                snapshot, n_pad=32, ns_resolver=plugin.ns_resolver)
+            pending = []
+            for i in range(8):
+                sel = rng.choice([
+                    {"matchLabels": {"team": "a"}},
+                    {"matchLabels": {"team": "b"}}, {}])
+                aff = affinity_spec(
+                    required=[self.ns_term(rng.choice(APPS), ZONE, sel)]
+                    if rng.random() < 0.5 else None,
+                    anti=[self.ns_term(rng.choice(APPS), HOSTNAME, sel)]
+                    if rng.random() < 0.7 else None)
+                if not aff:
+                    continue
+                pending.append(PodInfo(make_pod(
+                    f"nssel-{i}", labels={"app": rng.choice(APPS)},
+                    affinity=aff, namespace=rng.choice(
+                        ["default", "other"]), uid=f"nu{i}")))
+            for pi in pending:
+                assert compiler.supported(pi)
+                row = compiler.filter_row(pi)
+                state = CycleState()
+                st = plugin.pre_filter(state, pi, snapshot)
+                for j, ni in enumerate(snapshot.nodes):
+                    host_ok = True if st.is_skip() else \
+                        plugin.filter(state, pi, ni).is_success()
+                    assert bool(row[j]) == host_ok, (
+                        f"seed={seed} pod={pi.key} node={ni.name}: "
+                        f"tensor={bool(row[j])} host={host_ok}")

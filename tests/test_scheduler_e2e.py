@@ -165,3 +165,41 @@ class TestE2E:
             factory.stop()
             store.stop()
         run(body())
+
+    def test_namespace_selector_affinity_e2e(self):
+        """namespaceSelector terms resolve against live Namespace objects
+        (reference PreFilter namespace merge): a pod in ns `web` requires
+        co-zone with hub pods in any namespace labeled team=infra."""
+        async def body():
+            from kubernetes_tpu.api.meta import new_object
+            store = await make_cluster(0)
+            for name, labels in (("web", {"team": "app"}),
+                                 ("infra-a", {"team": "infra"})):
+                await store.create("namespaces", new_object(
+                    "Namespace", name, None, labels=labels))
+            for zone, name in (("a", "za-1"), ("b", "zb-1")):
+                await store.create("nodes", make_node(
+                    name, labels={"topology.kubernetes.io/zone": zone}))
+            sched, factory = await start_scheduler(store)
+            loop = asyncio.ensure_future(sched.run())
+            await store.create("pods", make_pod(
+                "hub", namespace="infra-a", labels={"app": "hub"},
+                node_selector={"topology.kubernetes.io/zone": "b"}))
+            await wait_bound(store, 1)
+            aff = {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"labelSelector": {"matchLabels": {"app": "hub"}},
+                     "namespaceSelector": {"matchLabels": {"team": "infra"}},
+                     "topologyKey": "topology.kubernetes.io/zone"}]}}
+            await store.create("pods", make_pod(
+                "w1", namespace="web", affinity=aff))
+            bound = await wait_bound(store, 2)
+            by_name = {p["metadata"]["name"]: p["spec"].get("nodeName")
+                       for p in bound}
+            # cross-namespace affinity pulled w1 into the hub's zone
+            assert by_name.get("w1") == "zb-1", by_name
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
