@@ -62,9 +62,16 @@ class NamespaceResolver:
             self._epoch += 1
             self._memo.clear()
 
+        def on_update(old, new):
+            # Resolution depends only on labels: an annotation/status
+            # touch must not invalidate compiled affinity state (the
+            # epoch gates an O(cluster) recompile downstream).
+            if (old.get("metadata", {}).get("labels") or {}) != \
+                    (new.get("metadata", {}).get("labels") or {}):
+                bump()
+
         self._informer.add_event_handler(ResourceEventHandler(
-            on_add=bump, on_update=lambda old, new: bump(),
-            on_delete=bump))
+            on_add=bump, on_update=on_update, on_delete=bump))
 
     @property
     def epoch(self) -> int:

@@ -15,9 +15,17 @@ from kubernetes_tpu.store.mvcc import (
     binding_subresource,
     new_cluster_store,
 )
+from kubernetes_tpu.store.durable import (
+    DurabilityManager,
+    WriteAheadLog,
+    recover_store,
+)
 from kubernetes_tpu.store.validation import install_core_validation
 
 __all__ = [
+    "DurabilityManager",
+    "WriteAheadLog",
+    "recover_store",
     "AlreadyExists",
     "Conflict",
     "Event",

@@ -168,6 +168,8 @@ class MVCCStore:
         # deleted CRD must drop its entries (install_crd_support).
         self.custom_kinds: dict[str, str] = {}
         self.custom_cluster_scoped: set[str] = set()
+        #: durability sinks (add_event_sink) — called per committed event.
+        self._event_sinks: list = []
 
     # -- helpers -----------------------------------------------------------
 
@@ -193,7 +195,28 @@ class MVCCStore:
             drop = len(self._events) - self._event_window
             self._first_retained_rv = self._events[drop - 1][1].rv + 1
             del self._events[:drop]
+        # Durability sinks (store/durable.py WAL) observe every committed
+        # event BEFORE watch dispatch — the etcd raft-log position. A sink
+        # failure must not fail the (already committed) write nor starve
+        # live watchers of the event: the sink owns its own degradation
+        # (the WAL marks itself broken and stops appending).
+        for sink in self._event_sinks:
+            try:
+                sink(resource, ev)
+            except Exception:
+                logger.exception("event sink failed; write stays committed")
         self._dispatch(resource, ev)
+
+    def add_event_sink(self, sink) -> None:
+        """Register a synchronous (resource, Event) observer for every
+        committed write (SURVEY §5.4 WAL attachment point)."""
+        self._event_sinks.append(sink)
+
+    def remove_event_sink(self, sink) -> None:
+        try:
+            self._event_sinks.remove(sink)
+        except ValueError:
+            pass
 
     @staticmethod
     def _select_event(ev: Event, selector: Selector | None) -> Event | None:

@@ -1,0 +1,250 @@
+"""Store durability: WAL + snapshot + crash recovery (SURVEY §5.4).
+
+The contract proved here: a killed-and-restarted control plane resumes
+with resourceVersion continuity, watches resume across the restart for
+rvs newer than the last snapshot, and older rvs get 410 Expired (the
+informer relist signal).
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import unittest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import (
+    DurabilityManager,
+    Expired,
+    install_core_validation,
+    new_cluster_store,
+    recover_store,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestWALRecovery(unittest.TestCase):
+    def test_crash_recovery_rv_continuity_and_watch_resume(self):
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = DurabilityManager(store, d, fsync="always",
+                                    snapshot_interval_s=3600)
+            await store.create("nodes", make_node("n0"))
+            for i in range(5):
+                await store.create("pods", make_pod(f"p{i}"))
+            snap_rv = mgr.wal.snapshot()          # checkpoint mid-history
+            created = await store.create("pods", make_pod("after-snap"))
+            rv_before_crash = int(created["metadata"]["resourceVersion"])
+            await store.create("pods", make_pod("last"))
+            uid_last = (await store.get("pods", "default/last"))[
+                "metadata"]["uid"]
+            final_rv = store.resource_version
+            # CRASH: no clean close, no final snapshot — the WAL alone
+            # must carry the post-snapshot writes (fsync="always").
+            del store, mgr
+
+            re_store = recover_store(d)
+            install_core_validation(re_store)
+            # state + rv continuity
+            self.assertEqual(re_store.resource_version, final_rv)
+            pods = (await re_store.list("pods")).items
+            self.assertEqual(len(pods), 7)
+            self.assertEqual(
+                (await re_store.get("pods", "default/last"))[
+                    "metadata"]["uid"], uid_last)
+            fresh = await re_store.create("pods", make_pod("post-restart"))
+            self.assertEqual(int(fresh["metadata"]["resourceVersion"]),
+                             final_rv + 1)
+            # watch resumes exactly where the crashed watcher stopped
+            watch = await re_store.watch(
+                "pods", resource_version=rv_before_crash)
+            got = []
+            async for ev in watch:
+                if ev.type == "BOOKMARK":
+                    continue
+                got.append((ev.type, ev.object["metadata"]["name"]))
+                if len(got) == 2:
+                    break
+            self.assertEqual(got, [("ADDED", "last"),
+                                   ("ADDED", "post-restart")])
+            # pre-snapshot rvs are compacted -> 410 Expired (relist)
+            with self.assertRaises(Expired):
+                await re_store.watch("pods", resource_version=snap_rv - 3)
+            re_store.stop()
+        run(body())
+
+    def test_deletes_and_updates_survive(self):
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            DurabilityManager(store, d, fsync="always",
+                              snapshot_interval_s=3600)
+            await store.create("pods", make_pod("keep"))
+            await store.create("pods", make_pod("gone"))
+            await store.delete("pods", "default/gone")
+
+            def label(obj):
+                obj["metadata"].setdefault("labels", {})["x"] = "1"
+                return obj
+            await store.guaranteed_update("pods", "default/keep", label)
+            del store
+
+            re_store = recover_store(d)
+            pods = (await re_store.list("pods")).items
+            self.assertEqual([p["metadata"]["name"] for p in pods],
+                             ["keep"])
+            self.assertEqual(pods[0]["metadata"]["labels"]["x"], "1")
+            re_store.stop()
+        run(body())
+
+    def test_torn_tail_truncates_not_corrupts(self):
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            DurabilityManager(store, d, fsync="always",
+                              snapshot_interval_s=3600)
+            await store.create("pods", make_pod("a"))
+            await store.create("pods", make_pod("b"))
+            # simulate a torn write at the tail
+            wal = [f for f in os.listdir(d) if f.startswith("wal-")][0]
+            with open(os.path.join(d, wal), "a") as f:
+                f.write('[9999,"ADDED","po')  # no newline, truncated JSON
+            del store
+            re_store = recover_store(d)
+            names = sorted(p["metadata"]["name"]
+                           for p in (await re_store.list("pods")).items)
+            self.assertEqual(names, ["a", "b"])
+            self.assertLess(re_store.resource_version, 9999)
+            re_store.stop()
+        run(body())
+
+    def test_periodic_snapshot_compacts_and_recovers(self):
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            mgr = DurabilityManager(store, d, fsync="batch",
+                                    flush_interval_s=0.01,
+                                    snapshot_interval_s=0.05)
+            mgr.start()
+            for i in range(30):
+                await store.create("pods", make_pod(f"p{i}"))
+                await asyncio.sleep(0.005)
+            await asyncio.sleep(0.1)  # let a snapshot land
+            snaps = [f for f in os.listdir(d) if f.startswith("snapshot-")]
+            self.assertTrue(snaps, "no periodic snapshot written")
+            await mgr.stop()
+            del store
+            re_store = recover_store(d)
+            self.assertEqual(
+                len((await re_store.list("pods")).items), 30)
+            re_store.stop()
+        run(body())
+
+    def test_selector_watch_transition_survives_restart(self):
+        """prev_labels ride the WAL: a selector watcher resuming across
+        the restart sees the synthesized DELETED for a label transition
+        that happened while it was down (cacher prevObject semantics)."""
+        async def body():
+            import tempfile
+            from kubernetes_tpu.api.labels import parse_selector
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            DurabilityManager(store, d, fsync="always",
+                              snapshot_interval_s=3600)
+            created = await store.create(
+                "pods", make_pod("a", labels={"app": "web"}))
+            rv0 = int(created["metadata"]["resourceVersion"])
+
+            def drop(obj):
+                obj["metadata"]["labels"] = {}
+                return obj
+            await store.guaranteed_update("pods", "default/a", drop)
+            del store  # crash
+
+            re_store = recover_store(d)
+            watch = await re_store.watch(
+                "pods", resource_version=rv0,
+                selector=parse_selector("app=web"))
+            async for ev in watch:
+                if ev.type == "BOOKMARK":
+                    continue
+                self.assertEqual(ev.type, "DELETED")
+                self.assertEqual(ev.object["metadata"]["name"], "a")
+                break
+            re_store.stop()
+        run(body())
+
+    def test_control_plane_restart_e2e(self):
+        """Full loop: scheduler binds pods, the process 'dies', a new
+        control plane recovers the store and keeps scheduling — bound
+        pods stay bound, pending pods get scheduled."""
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            DurabilityManager(store, d, fsync="always",
+                              snapshot_interval_s=3600)
+            for i in range(3):
+                await store.create("nodes", make_node(f"n{i}"))
+            sched = Scheduler(store, seed=1)
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            loop = asyncio.ensure_future(sched.run(batch_size=8))
+            for i in range(4):
+                await store.create("pods", make_pod(f"p{i}"))
+            for _ in range(200):
+                pods = (await store.list("pods")).items
+                if sum(1 for p in pods
+                       if p["spec"].get("nodeName")) == 4:
+                    break
+                await asyncio.sleep(0.02)
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            # crash + restart
+            del store
+            re_store = recover_store(d)
+            install_core_validation(re_store)
+            pods = (await re_store.list("pods")).items
+            bound = {p["metadata"]["name"]: p["spec"].get("nodeName")
+                     for p in pods}
+            self.assertEqual(sum(1 for v in bound.values() if v), 4)
+            sched2 = Scheduler(re_store, seed=2)
+            factory2 = InformerFactory(re_store)
+            await sched2.setup_informers(factory2)
+            factory2.start()
+            await factory2.wait_for_sync()
+            loop2 = asyncio.ensure_future(sched2.run(batch_size=8))
+            await re_store.create("pods", make_pod("new-after-restart"))
+            ok = False
+            for _ in range(200):
+                p = await re_store.get("pods", "default/new-after-restart")
+                if p["spec"].get("nodeName"):
+                    ok = True
+                    break
+                await asyncio.sleep(0.02)
+            self.assertTrue(ok, "recovered control plane failed to bind")
+            # bindings persisted before the crash are untouched
+            for name, node in bound.items():
+                cur = await re_store.get("pods", f"default/{name}")
+                self.assertEqual(cur["spec"].get("nodeName"), node)
+            await sched2.stop()
+            loop2.cancel()
+            factory2.stop()
+            re_store.stop()
+        run(body())
+
+
+if __name__ == "__main__":
+    unittest.main()
