@@ -152,10 +152,15 @@ KIND_TO_RESOURCE = {
     "ResourceClaimTemplate": "resourceclaimtemplates",
     "DeviceClass": "deviceclasses",
     "ResourceSlice": "resourceslices",
+    "CronJob": "cronjobs",
+    "ServiceAccount": "serviceaccounts",
+    "Secret": "secrets",
+    "VolumeAttachment": "volumeattachments",
 }
 
 #: resources without a namespace segment in their keys/URLs.
 CLUSTER_SCOPED_RESOURCES = {
     "nodes", "namespaces", "persistentvolumes", "storageclasses",
     "noderesourcetopologies", "deviceclasses", "resourceslices",
+    "volumeattachments",
 }

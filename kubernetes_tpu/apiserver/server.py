@@ -174,6 +174,7 @@ class APIServer:
                  host: str = "127.0.0.1", port: int = 0,
                  priority_levels: Mapping[str, PriorityLevel] | None = None,
                  bearer_tokens: Mapping[str, str] | None = None,
+                 token_authenticator=None,
                  user_groups: Mapping[str, list[str]] | None = None,
                  authorizer=None,
                  admission=None,
@@ -189,6 +190,9 @@ class APIServer:
             "workload": PriorityLevel("workload", seats=32),
         })
         self.bearer_tokens = dict(bearer_tokens or {})  # token -> username
+        #: dynamic authenticator (ServiceAccountAuthenticator): token ->
+        #: username | None, consulted after the static map.
+        self.token_authenticator = token_authenticator
         #: username -> group names, the authn side of Group subjects; the
         #: implicit system:authenticated/unauthenticated groups are added
         #: per-request (reference: authenticatorfactory + user.Info.Groups).
@@ -288,8 +292,11 @@ class APIServer:
         if auth.startswith("Bearer "):
             token = auth[len("Bearer "):]
             user = self.bearer_tokens.get(token)
+            if user is None and self.token_authenticator is not None:
+                user = self.token_authenticator(token)
             if user is None:
-                if self.bearer_tokens:
+                if self.bearer_tokens or \
+                        self.token_authenticator is not None:
                     return web.json_response(
                         _status_body(401, "Unauthorized", "invalid token"),
                         status=401)

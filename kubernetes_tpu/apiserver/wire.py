@@ -318,7 +318,10 @@ class _Conn(asyncio.Protocol):
         self.flow = args.get("ua") or "wire"
         if token:
             user = srv.bearer_tokens.get(token)
-            if user is None and srv.bearer_tokens:
+            if user is None and srv.token_authenticator is not None:
+                user = srv.token_authenticator(token)
+            if user is None and (srv.bearer_tokens
+                                 or srv.token_authenticator is not None):
                 self._err(rid, "Unauthorized", "invalid token")
                 # The HTTP chain 401s EVERY request carrying a bad token;
                 # the connection-oriented analog is to refuse the session
@@ -446,6 +449,7 @@ class WireServer:
     def __init__(self, store: MVCCStore, *, host: str = "127.0.0.1",
                  port: int = 0, priority_levels: Mapping | None = None,
                  bearer_tokens: Mapping[str, str] | None = None,
+                 token_authenticator=None,
                  user_groups: Mapping[str, list[str]] | None = None,
                  authorizer=None, admission=None):
         self.store = store
@@ -453,6 +457,7 @@ class WireServer:
         self.port = port
         self.priority_levels = dict(priority_levels or {})
         self.bearer_tokens = dict(bearer_tokens or {})
+        self.token_authenticator = token_authenticator
         self.user_groups = {u: list(g) for u, g in
                             (user_groups or {}).items()}
         self.authorizer = authorizer
@@ -469,6 +474,7 @@ class WireServer:
         return cls(api.store, host=host, port=port,
                    priority_levels=api.priority_levels,
                    bearer_tokens=api.bearer_tokens,
+                   token_authenticator=api.token_authenticator,
                    user_groups=api.user_groups,
                    authorizer=api.authorizer, admission=api.admission)
 

@@ -1,6 +1,12 @@
 """Controllers tier: reconcile loops over the store (SURVEY §2.4/§3.4)."""
 
+from kubernetes_tpu.controllers.attachdetach import AttachDetachController
 from kubernetes_tpu.controllers.base import Controller, ControllerManager
+from kubernetes_tpu.controllers.cronjob import (
+    CronJobController,
+    CronSchedule,
+    make_cronjob,
+)
 from kubernetes_tpu.controllers.daemonset import (
     DaemonSetController,
     make_daemonset,
@@ -44,6 +50,11 @@ from kubernetes_tpu.controllers.replicaset import (
     make_replicaset,
 )
 from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
+from kubernetes_tpu.controllers.serviceaccount import (
+    ServiceAccountAuthenticator,
+    ServiceAccountController,
+    TokenController,
+)
 from kubernetes_tpu.controllers.statefulset import (
     StatefulSetController,
     make_statefulset,
@@ -72,5 +83,9 @@ __all__ = [
     "PVBinderController",
     "ReplicaSetController", "make_replicaset",
     "ResourceClaimController",
+    "AttachDetachController",
+    "CronJobController", "CronSchedule", "make_cronjob",
+    "ServiceAccountAuthenticator", "ServiceAccountController",
+    "TokenController",
     "StatefulSetController", "make_statefulset",
 ]

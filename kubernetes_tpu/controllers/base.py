@@ -53,11 +53,12 @@ class Controller:
         factory.informer(resource).add_event_handler(ResourceEventHandler(
             on_add=enq, on_update=lambda old, new: enq(new), on_delete=enq))
 
-    def watch_owned_pods(self, factory: InformerFactory, kind: str) -> None:
-        """Pod events map back to the owning controller's key via the
-        controllerRef (the addPod/deletePod pattern every workload
-        controller shares)."""
-        def pod_to_owner(obj):
+    def watch_owned(self, factory: InformerFactory, resource: str,
+                    kind: str) -> None:
+        """Events of `resource` map back to the owning controller's key
+        via the controllerRef (the addPod/deletePod pattern every
+        workload controller shares — generalized for Job→CronJob etc.)."""
+        def to_owner(obj):
             for ref in obj.get("metadata", {}).get("ownerReferences") or []:
                 if ref.get("controller") and ref.get("kind") == kind:
                     ns = obj["metadata"].get("namespace", "default")
@@ -65,9 +66,12 @@ class Controller:
                         self.queue.add(f"{ns}/{ref['name']}"))
                     return
 
-        factory.informer("pods").add_event_handler(ResourceEventHandler(
-            on_add=pod_to_owner, on_update=lambda o, n: pod_to_owner(n),
-            on_delete=pod_to_owner))
+        factory.informer(resource).add_event_handler(ResourceEventHandler(
+            on_add=to_owner, on_update=lambda o, n: to_owner(n),
+            on_delete=to_owner))
+
+    def watch_owned_pods(self, factory: InformerFactory, kind: str) -> None:
+        self.watch_owned(factory, "pods", kind)
 
     async def enqueue(self, key: str) -> None:
         await self.queue.add(key)

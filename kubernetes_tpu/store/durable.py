@@ -88,15 +88,18 @@ class WriteAheadLog:
                 os.fsync(self._fh.fileno())
             else:
                 self._dirty = True
-        except (OSError, ValueError):
+        except (OSError, ValueError, TypeError):
+            # TypeError: unserializable object — skipping just one record
+            # would punch a silent hole in the log, so freeze instead.
             self.broken = True
             logger.exception(
                 "WAL append failed; log is now FROZEN at a consistent "
                 "prefix (durability degraded, store stays live)")
 
     def flush(self) -> None:
-        """Group commit (fsync="batch"): called from the manager's tick
-        (in a worker thread — fsync must not stall the event loop)."""
+        """Group commit (fsync="batch"), synchronous: python buffer → OS
+        → disk. Safe only from the event loop (TextIOWrapper is not
+        thread-safe against concurrent writes)."""
         if self._dirty and not self.broken:
             try:
                 self._fh.flush()
@@ -105,6 +108,22 @@ class WriteAheadLog:
             except (OSError, ValueError):
                 self.broken = True
                 logger.exception("WAL flush failed; log FROZEN")
+
+    def flush_to_os(self) -> int | None:
+        """Loop-side half of the threaded group commit: drain the
+        TextIOWrapper buffer (must happen on the loop — concurrent
+        write()/flush() on a text file corrupts it) and return the fd
+        for the caller to fsync OFF the loop. None = nothing to sync."""
+        if not self._dirty or self.broken:
+            return None
+        try:
+            self._fh.flush()
+            self._dirty = False
+            return self._fh.fileno()
+        except (OSError, ValueError):
+            self.broken = True
+            logger.exception("WAL flush failed; log FROZEN")
+            return None
 
     # -- snapshot + compaction --------------------------------------------
 
@@ -180,15 +199,25 @@ class DurabilityManager:
         try:
             while True:
                 await asyncio.sleep(self.flush_interval_s)
-                # fsync happens off-loop (group commit); the durability
-                # window in "batch" mode is one flush interval.
-                await asyncio.to_thread(self.wal.flush)
+                # Buffer drain on the loop (text I/O is not thread-safe
+                # against concurrent writes); only the fsync goes to a
+                # worker thread. Durability window in "batch" mode is
+                # one flush interval.
+                fd = self.wal.flush_to_os()
+                if fd is not None:
+                    try:
+                        await asyncio.to_thread(os.fsync, fd)
+                    except OSError:
+                        pass  # segment rotated underneath; its rotation
+                        #       already flushed the data
                 now = time.monotonic()
                 log_span = self.store.resource_version - self.wal._base_rv
-                if now - last_snap >= self.snapshot_interval_s or \
-                        log_span >= self.snapshot_every_events:
+                if log_span > 0 and (
+                        now - last_snap >= self.snapshot_interval_s
+                        or log_span >= self.snapshot_every_events):
                     # Capture + rotate atomically on the loop; the disk
-                    # write runs in a worker thread.
+                    # write runs in a worker thread. Idle clusters
+                    # (log_span 0) skip re-snapshotting identical state.
                     data, rv = self.wal.begin_snapshot()
                     await asyncio.to_thread(self.wal.write_snapshot,
                                             data, rv)

@@ -1,0 +1,319 @@
+"""CronJob, ServiceAccount/token, attach-detach controllers (SURVEY §2.4
+long tail — the round-4 controller-tier completion)."""
+
+import asyncio
+import unittest
+from datetime import datetime, timezone
+
+from kubernetes_tpu.api.meta import new_object
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.controllers import (
+    AttachDetachController,
+    CronJobController,
+    CronSchedule,
+    ServiceAccountAuthenticator,
+    ServiceAccountController,
+    TokenController,
+    make_cronjob,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.store.mvcc import StoreError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def ts(s: str) -> datetime:
+    return datetime.fromisoformat(s).replace(tzinfo=timezone.utc)
+
+
+class TestCronSchedule(unittest.TestCase):
+    def test_every_minute(self):
+        s = CronSchedule("* * * * *")
+        self.assertEqual(s.next_after(ts("2026-07-30T10:00:30")),
+                         ts("2026-07-30T10:01:00"))
+
+    def test_specific_minute_hour(self):
+        s = CronSchedule("30 2 * * *")
+        self.assertEqual(s.next_after(ts("2026-07-30T10:00:00")),
+                         ts("2026-07-31T02:30:00"))
+        self.assertEqual(s.next_after(ts("2026-07-30T01:00:00")),
+                         ts("2026-07-30T02:30:00"))
+
+    def test_step_and_list(self):
+        s = CronSchedule("*/15 8-10 * * *")
+        self.assertEqual(s.next_after(ts("2026-07-30T08:20:00")),
+                         ts("2026-07-30T08:30:00"))
+        self.assertEqual(s.next_after(ts("2026-07-30T10:46:00")),
+                         ts("2026-07-31T08:00:00"))
+
+    def test_day_of_week(self):
+        s = CronSchedule("0 9 * * 1")  # Mondays 09:00
+        # 2026-07-30 is a Thursday; next Monday is 2026-08-03.
+        self.assertEqual(s.next_after(ts("2026-07-30T12:00:00")),
+                         ts("2026-08-03T09:00:00"))
+
+    def test_month_rollover(self):
+        s = CronSchedule("0 0 1 * *")  # first of the month
+        self.assertEqual(s.next_after(ts("2026-12-15T00:00:00")),
+                         ts("2027-01-01T00:00:00"))
+
+    def test_bad_spec_rejected(self):
+        with self.assertRaises(ValueError):
+            CronSchedule("61 * * * *")
+        with self.assertRaises(ValueError):
+            CronSchedule("* * *")
+
+
+class ControllerHarness:
+    def __init__(self, controllers):
+        self.controllers = controllers
+
+    async def __aenter__(self):
+        self.store = new_cluster_store()
+        install_core_validation(self.store)
+        self.factory = InformerFactory(self.store)
+        self.built = [ctor(self.store) for ctor in self.controllers]
+        for c in self.built:
+            c.setup(self.factory)
+        self.factory.start()
+        await self.factory.wait_for_sync()
+        for c in self.built:
+            c.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        for c in self.built:
+            await c.stop()
+        self.factory.stop()
+        self.store.stop()
+
+    async def wait_for(self, pred, timeout=5.0, msg="condition"):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            got = await pred()
+            if got:
+                return got
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestCronJobController(unittest.TestCase):
+    def test_schedule_spawns_job_and_records_last_schedule(self):
+        async def body():
+            clock = [ts("2026-07-30T10:00:30")]
+
+            def build(store):
+                return CronJobController(store, now=lambda: clock[0])
+
+            async with ControllerHarness([build]) as h:
+                cj = make_cronjob("tick", "* * * * *")
+                cj["metadata"]["creationTimestamp"] = \
+                    "2026-07-30T10:00:00Z"
+                await h.store.create("cronjobs", cj)
+                clock[0] = ts("2026-07-30T10:01:10")  # minute boundary hit
+
+                async def job_exists():
+                    jobs = (await h.store.list("jobs")).items
+                    return jobs or None
+                jobs = await h.wait_for(job_exists, msg="job spawn")
+                self.assertEqual(len(jobs), 1)
+                ref = jobs[0]["metadata"]["ownerReferences"][0]
+                self.assertEqual(ref["kind"], "CronJob")
+                cj = await h.store.get("cronjobs", "default/tick")
+                self.assertEqual(cj["status"]["lastScheduleTime"],
+                                 "2026-07-30T10:01:00Z")
+                # same tick never double-fires
+                await asyncio.sleep(0.2)
+                self.assertEqual(
+                    len((await h.store.list("jobs")).items), 1)
+                # next minute fires the second job
+                clock[0] = ts("2026-07-30T10:02:05")
+
+                async def two_jobs():
+                    return len((await h.store.list("jobs")).items) == 2 \
+                        or None
+                await h.wait_for(two_jobs, msg="second spawn")
+        run(body())
+
+    def test_forbid_policy_skips_while_active(self):
+        async def body():
+            clock = [ts("2026-07-30T10:00:30")]
+
+            def build(store):
+                return CronJobController(store, now=lambda: clock[0])
+
+            async with ControllerHarness([build]) as h:
+                cj = make_cronjob("solo", "* * * * *",
+                                  concurrency_policy="Forbid")
+                cj["metadata"]["creationTimestamp"] = \
+                    "2026-07-30T10:00:00Z"
+                await h.store.create("cronjobs", cj)
+                clock[0] = ts("2026-07-30T10:01:10")
+
+                async def one_job():
+                    jobs = (await h.store.list("jobs")).items
+                    return jobs or None
+                await h.wait_for(one_job, msg="first spawn")
+                # job still active; the next tick must NOT spawn
+                clock[0] = ts("2026-07-30T10:02:10")
+                await asyncio.sleep(0.3)
+                self.assertEqual(
+                    len((await h.store.list("jobs")).items), 1)
+        run(body())
+
+    def test_suspend_blocks_spawning(self):
+        async def body():
+            clock = [ts("2026-07-30T10:00:30")]
+
+            def build(store):
+                return CronJobController(store, now=lambda: clock[0])
+
+            async with ControllerHarness([build]) as h:
+                await h.store.create("cronjobs", make_cronjob(
+                    "paused", "* * * * *", suspend=True))
+                clock[0] = ts("2026-07-30T10:05:00")
+                await asyncio.sleep(0.3)
+                self.assertEqual((await h.store.list("jobs")).items, [])
+        run(body())
+
+
+class TestServiceAccounts(unittest.TestCase):
+    def test_default_sa_and_token_lifecycle(self):
+        async def body():
+            async with ControllerHarness(
+                    [ServiceAccountController, TokenController]) as h:
+                await h.store.create("namespaces", new_object(
+                    "Namespace", "team-a", None))
+
+                async def sa_ready():
+                    try:
+                        return await h.store.get(
+                            "serviceaccounts", "team-a/default")
+                    except StoreError:
+                        return None
+                await h.wait_for(sa_ready, msg="default SA")
+
+                async def token_ready():
+                    secrets = (await h.store.list(
+                        "secrets", namespace="team-a")).items
+                    return secrets or None
+                secrets = await h.wait_for(token_ready, msg="token secret")
+                token = secrets[0]["data"]["token"]
+                self.assertTrue(token.startswith("sa-"))
+                # deleting the SA removes its token; the default SA is
+                # then recreated with a fresh one
+                await h.store.delete("serviceaccounts", "team-a/default")
+
+                async def rotated():
+                    secrets = (await h.store.list(
+                        "secrets", namespace="team-a")).items
+                    if len(secrets) == 1 and \
+                            secrets[0]["data"]["token"] != token:
+                        return secrets
+                    return None
+                await h.wait_for(rotated, msg="token rotation")
+        run(body())
+
+    def test_issued_token_authenticates_and_rbac_binds(self):
+        async def body():
+            from kubernetes_tpu.apiserver.rbac import RBACAuthorizer
+            async with ControllerHarness(
+                    [ServiceAccountController, TokenController]) as h:
+                authn = ServiceAccountAuthenticator(h.factory)
+                await h.store.create("namespaces", new_object(
+                    "Namespace", "ci", None))
+
+                async def token_ready():
+                    secrets = (await h.store.list(
+                        "secrets", namespace="ci")).items
+                    return secrets or None
+                secrets = await h.wait_for(token_ready, msg="token")
+                token = secrets[0]["data"]["token"]
+                authz = RBACAuthorizer()
+                authz.add_role({"metadata": {"name": "podreader"},
+                                "rules": [{"verbs": ["get", "list"],
+                                           "resources": ["pods"]}]})
+                authz.add_binding({
+                    "roleRef": {"kind": "ClusterRole",
+                                "name": "podreader"},
+                    "subjects": [{"kind": "ServiceAccount",
+                                  "name": "default",
+                                  "namespace": "ci"}]})
+                server = WireServer(h.store, token_authenticator=authn,
+                                    authorizer=authz)
+                await server.start()
+                client = WireStore(server.target, token=token)
+                try:
+                    await h.store.create("pods", make_pod("a"))
+                    got = await client.get("pods", "default/a")
+                    self.assertEqual(got["metadata"]["name"], "a")
+                    with self.assertRaises(StoreError):
+                        await client.create("pods", make_pod("b"))
+                    bad = WireStore(server.target, token="sa-forged")
+                    with self.assertRaises(StoreError):
+                        await bad.get("pods", "default/a")
+                    await bad.close()
+                finally:
+                    await client.close()
+                    await server.stop()
+        run(body())
+
+
+class TestAttachDetach(unittest.TestCase):
+    def test_attach_on_schedule_detach_on_delete(self):
+        async def body():
+            async with ControllerHarness([AttachDetachController]) as h:
+                await h.store.create("nodes", make_node("n0"))
+                await h.store.create("persistentvolumes", new_object(
+                    "PersistentVolume", "pv-1", None,
+                    spec={"capacity": {"storage": "10Gi"}}))
+                pvc = new_object("PersistentVolumeClaim", "data", "default",
+                                 spec={"volumeName": "pv-1"})
+                await h.store.create("persistentvolumeclaims", pvc)
+                pod = make_pod("user", node_name="n0")
+                pod["spec"]["volumes"] = [{
+                    "name": "data",
+                    "persistentVolumeClaim": {"claimName": "data"}}]
+                await h.store.create("pods", pod)
+
+                async def attached():
+                    vas = (await h.store.list("volumeattachments")).items
+                    for va in vas:
+                        if va["spec"]["source"][
+                                "persistentVolumeName"] == "pv-1" \
+                                and va["spec"]["nodeName"] == "n0" \
+                                and va.get("status", {}).get("attached"):
+                            return va
+                    return None
+                await h.wait_for(attached, msg="attach")
+                # second pod on the same node/PV: attachment is shared
+                pod2 = make_pod("user2", node_name="n0")
+                pod2["spec"]["volumes"] = [{
+                    "name": "data",
+                    "persistentVolumeClaim": {"claimName": "data"}}]
+                await h.store.create("pods", pod2)
+                await asyncio.sleep(0.2)
+                self.assertEqual(
+                    len((await h.store.list("volumeattachments")).items),
+                    1)
+                # detach only after the LAST user leaves
+                await h.store.delete("pods", "default/user")
+                await asyncio.sleep(0.2)
+                self.assertEqual(
+                    len((await h.store.list("volumeattachments")).items),
+                    1)
+                await h.store.delete("pods", "default/user2")
+
+                async def detached():
+                    vas = (await h.store.list("volumeattachments")).items
+                    return True if not vas else None
+                await h.wait_for(detached, msg="detach")
+        run(body())
+
+
+if __name__ == "__main__":
+    unittest.main()
