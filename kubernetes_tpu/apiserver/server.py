@@ -85,23 +85,32 @@ def _error_response(exc: StoreError) -> web.Response:
 
 
 class PriorityLevel:
-    """APF-lite: a seat pool with per-flow FIFO queues drained round-robin.
+    """APF fair queuing with shuffle sharding (pkg/util/flowcontrol).
 
-    `seats` concurrent requests execute; excess requests wait in their
-    flow's queue (flow = client identity); when `queue_limit` waiters are
-    already parked for a flow, new arrivals are rejected (429) — the
-    reference's reject-when-queues-full behavior.
+    `seats` concurrent requests execute. Excess requests park in one of
+    `num_queues` FIFO queues: a flow's identity deals it a HAND of
+    `hand_size` candidate queues (deterministic shuffle shard, the
+    reference's dealer) and the request joins the shortest — an elephant
+    flow fills at most its hand while mice flows' hands almost surely
+    include an uncontended queue. Seats drain queues round-robin (the
+    reference's virtual-finish-time fair queue, order-approximated).
+    A request arriving to a full shortest-queue gets 429 + Retry-After —
+    reject-when-queue-full.
     """
 
-    def __init__(self, name: str, seats: int = 16, queue_limit: int = 128):
+    def __init__(self, name: str, seats: int = 16, queue_limit: int = 128,
+                 num_queues: int = 64, hand_size: int = 8):
         self.name = name
         self.seats = seats
+        #: per-queue length limit (the reference's queueLengthLimit).
         self.queue_limit = queue_limit
+        self.num_queues = max(1, num_queues)
+        self.hand_size = max(1, min(hand_size, self.num_queues))
         self._in_use = 0
-        #: flow key -> deque of waiter futures
-        self._queues: dict[str, deque] = {}
-        #: round-robin order of flow keys with waiters
-        self._rr: deque[str] = deque()
+        self._queues: list[deque] = [deque() for _ in range(self.num_queues)]
+        #: round-robin dispatch cursor over queues.
+        self._rr_next = 0
+        self._waiting = 0
 
     @property
     def in_use(self) -> int:
@@ -109,16 +118,33 @@ class PriorityLevel:
 
     @property
     def queued(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        return self._waiting
+
+    def _hand(self, flow: str) -> list[int]:
+        """Deterministic shuffle shard: deal `hand_size` DISTINCT queue
+        indices from the flow's hash (shufflesharding.Dealer)."""
+        import hashlib
+        h = int.from_bytes(hashlib.blake2b(
+            f"{self.name}/{flow}".encode(), digest_size=8).digest(), "big")
+        hand = []
+        remaining = self.num_queues
+        for _ in range(self.hand_size):
+            h, pick = divmod(h, remaining)
+            # map pick over the indices not yet dealt
+            for taken in sorted(hand):
+                if pick >= taken:
+                    pick += 1
+            hand.append(pick)
+            remaining -= 1
+        return hand
 
     async def acquire(self, flow: str) -> None:
-        if self._in_use < self.seats and not self._rr:
+        if self._in_use < self.seats and self._waiting == 0:
             self._in_use += 1
             return
-        q = self._queues.get(flow)
-        if q is None:
-            q = self._queues[flow] = deque()
-            self._rr.append(flow)
+        hand = self._hand(flow)
+        qi = min(hand, key=lambda i: len(self._queues[i]))
+        q = self._queues[qi]
         if len(q) >= self.queue_limit:
             raise web.HTTPTooManyRequests(
                 headers={"Retry-After": "1"},
@@ -128,6 +154,7 @@ class PriorityLevel:
                 content_type="application/json")
         fut = asyncio.get_event_loop().create_future()
         q.append(fut)
+        self._waiting += 1
         try:
             await fut
         except asyncio.CancelledError:
@@ -138,32 +165,28 @@ class PriorityLevel:
             else:
                 try:
                     q.remove(fut)
+                    self._waiting -= 1
                 except ValueError:
                     pass
             raise
         # seat was transferred to us by release()
 
     def release(self) -> None:
-        # Hand the seat to the next flow in round-robin order.
-        while self._rr:
-            flow = self._rr[0]
-            q = self._queues.get(flow)
-            if not q:
-                self._rr.popleft()
-                self._queues.pop(flow, None)
-                continue
-            fut = q.popleft()
-            self._rr.rotate(-1)
-            if not q:
-                try:
-                    self._rr.remove(flow)
-                except ValueError:
-                    pass
-                self._queues.pop(flow, None)
-            if not fut.done():
-                fut.set_result(None)
-                return  # seat transferred
-            # waiter was cancelled; try the next one
+        if self._waiting == 0:  # uncontended hot path: skip the scan
+            self._in_use -= 1
+            return
+        # Hand the seat to the next waiter, round-robin across queues.
+        for _ in range(self.num_queues):
+            qi = self._rr_next
+            self._rr_next = (self._rr_next + 1) % self.num_queues
+            q = self._queues[qi]
+            while q:
+                fut = q.popleft()
+                self._waiting -= 1
+                if not fut.done():
+                    fut.set_result(None)
+                    return  # seat transferred
+                # waiter was cancelled; try the next in this queue
         self._in_use -= 1
 
 

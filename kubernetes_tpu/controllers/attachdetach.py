@@ -59,10 +59,13 @@ class AttachDetachController(Controller):
     def _desired(self) -> dict[str, tuple[str, str]]:
         """attachment name -> (pv, node) for every (PV, node) pair some
         scheduled pod references through a bound PVC."""
+        from kubernetes_tpu.api.types import pod_is_terminal
         want: dict[str, tuple[str, str]] = {}
         for pod in self.pod_informer.indexer.list():
             node = (pod.get("spec") or {}).get("nodeName")
-            if not node:
+            if not node or pod_is_terminal(pod):
+                # Terminated pods release their volumes (the reference's
+                # DesiredStateOfWorld excludes them).
                 continue
             ns = pod["metadata"].get("namespace", "default")
             for vol in (pod.get("spec") or {}).get("volumes") or []:

@@ -86,8 +86,11 @@ class CronSchedule:
         # cron dow: 0 and 7 are both Sunday; python weekday(): Mon=0.
         dow = _parse_field(fields[4], 0, 7)
         self.dow = {(d % 7) for d in dow}
-        self.dom_star = fields[2] in ("*",)
-        self.dow_star = fields[4] in ("*",)
+        # robfig/cron star semantics: any field BEGINNING with '*'
+        # (including "*/n") carries the star bit — dom AND dow then,
+        # vixie OR only when both are restricted lists.
+        self.dom_star = fields[2].startswith("*")
+        self.dow_star = fields[4].startswith("*")
 
     def _day_matches(self, t: datetime) -> bool:
         dom_ok = t.day in self.dom

@@ -172,12 +172,14 @@ class ServiceAccountAuthenticator:
         self._secret_token: dict[str, str] = {}
 
         def index(obj):
-            if obj.get("type") != SA_TOKEN_TYPE:
-                return
+            # Drop any stale entry FIRST: a token secret updated to a
+            # different type must stop authenticating immediately.
             key = namespaced_name(obj)
             old = self._secret_token.pop(key, None)
             if old is not None:
                 self._by_token.pop(old, None)
+            if obj.get("type") != SA_TOKEN_TYPE:
+                return
             data = obj.get("data") or {}
             token = data.get("token")
             ann = (obj.get("metadata") or {}).get("annotations") or {}

@@ -208,8 +208,14 @@ class DurabilityManager:
                     try:
                         await asyncio.to_thread(os.fsync, fd)
                     except OSError:
-                        pass  # segment rotated underneath; its rotation
-                        #       already flushed the data
+                        # Genuine sync failure (nothing rotates this fd
+                        # concurrently — snapshot rotation runs later in
+                        # THIS task): records the store already
+                        # acknowledged may not be on disk → freeze, same
+                        # contract as an append failure.
+                        self.wal.broken = True
+                        logger.exception(
+                            "WAL fsync failed; log FROZEN")
                 now = time.monotonic()
                 log_span = self.store.resource_version - self.wal._base_rv
                 if log_span > 0 and (

@@ -252,8 +252,10 @@ class TestAPF:
 
             srv = APIServer(store, priority_levels={
                 "system": PriorityLevel("system", seats=64),
+                # Single queue: queue_limit acts as the level's total
+                # backlog bound, the reject-when-full shape this test pins.
                 "workload": PriorityLevel(
-                    "workload", seats=2, queue_limit=2),
+                    "workload", seats=2, queue_limit=2, num_queues=1),
             })
             await srv.start()
             rs = RemoteStore(srv.url)
@@ -546,4 +548,93 @@ class TestProtobufContentNegotiation:
             await js.close()
             await srv.stop()
             store.stop()
+        run(body())
+
+
+class TestAPFFairQueuing:
+    """Shuffle-shard fair queuing (pkg/util/flowcontrol parity): an
+    elephant flow's backlog cannot starve a well-behaved mouse flow."""
+
+    def test_mouse_latency_bounded_under_elephant_flood(self):
+        async def body():
+            level = PriorityLevel("workload", seats=4, queue_limit=64,
+                                  num_queues=64, hand_size=8)
+
+            async def hold(flow, secs):
+                await level.acquire(flow)
+                try:
+                    await asyncio.sleep(secs)
+                finally:
+                    level.release()
+
+            # Elephant: 200 long requests from ONE flow — enough to fill
+            # its whole hand many times over.
+            flood = [asyncio.ensure_future(hold("elephant", 0.05))
+                     for _ in range(200)]
+            await asyncio.sleep(0.01)
+            assert level.queued > 100
+            # Mouse: sequential requests from another flow while seats
+            # stay contended. Its hand almost surely includes queues the
+            # elephant's hand doesn't cover, so its wait is ~one seat
+            # rotation, not the elephant's whole backlog drain.
+            import time as _t
+            lat = []
+            for _ in range(10):
+                t0 = _t.monotonic()
+                await hold("mouse", 0.001)
+                lat.append(_t.monotonic() - t0)
+            lat.sort()
+            p99 = lat[-1]
+            # Elephant backlog is ~200*0.05/4 ≈ 2.5s total; the mouse's
+            # SLO is a small multiple of one request's service time.
+            assert p99 < 0.5, f"mouse starved: p99={p99:.3f}s"
+            assert level.queued > 0, "flood should still be queued"
+            for f in flood:
+                f.cancel()
+            await asyncio.gather(*flood, return_exceptions=True)
+        run(body())
+
+    def test_shuffle_shard_deterministic_and_distinct(self):
+        level = PriorityLevel("w", num_queues=64, hand_size=8)
+        h1 = level._hand("flow-a")
+        assert h1 == level._hand("flow-a")
+        assert len(set(h1)) == 8
+        assert all(0 <= i < 64 for i in h1)
+        # different flows overwhelmingly get different hands
+        assert h1 != level._hand("flow-b")
+
+    def test_elephant_rejected_mouse_admitted_when_hand_full(self):
+        async def body():
+            # Tiny level: the elephant saturates its hand's queues and
+            # gets 429s; a mouse with a disjoint-ish hand still enqueues.
+            level = PriorityLevel("w", seats=1, queue_limit=1,
+                                  num_queues=16, hand_size=2)
+
+            async def hold(flow):
+                await level.acquire(flow)
+
+            blocker = asyncio.ensure_future(hold("elephant"))
+            await asyncio.sleep(0)
+            # fill the elephant's two hand queues
+            parked = [asyncio.ensure_future(hold("elephant"))
+                      for _ in range(2)]
+            await asyncio.sleep(0)
+            from aiohttp import web
+            with pytest.raises(web.HTTPTooManyRequests):
+                await level.acquire("elephant")
+            # the mouse's hand has room unless it collides on BOTH queues
+            # (this flow is chosen to not collide for the fixed hash)
+            for name in ("mouse-a", "mouse-b", "mouse-c"):
+                if set(level._hand(name)) != set(level._hand("elephant")):
+                    mouse = asyncio.ensure_future(hold(name))
+                    await asyncio.sleep(0)
+                    assert not mouse.done() or mouse.exception() is None
+                    mouse.cancel()
+                    break
+            else:
+                raise AssertionError("all mice collided (hash broken?)")
+            for t in (blocker, *parked):
+                t.cancel()
+            await asyncio.gather(blocker, *parked,
+                                 return_exceptions=True)
         run(body())
