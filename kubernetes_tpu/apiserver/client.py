@@ -220,6 +220,20 @@ class RemoteStore:
         async with self._sess().post(url, json=dict(body)) as resp:
             return await self._json(resp)
 
+    async def apply(self, resource: str, obj: Mapping, *,
+                    field_manager: str, force: bool = False) -> dict:
+        """Server-side apply (PATCH application/apply-patch+yaml)."""
+        key = namespaced_name(obj)
+        params = {"fieldManager": field_manager}
+        if force:
+            params["force"] = "true"
+        async with self._sess().patch(
+                self._item_url(resource, key), params=params,
+                data=json.dumps(dict(obj)),
+                headers={"Content-Type":
+                         "application/apply-patch+yaml"}) as resp:
+            return await self._json(resp)
+
     # -- LIST + WATCH ------------------------------------------------------
 
     async def list(

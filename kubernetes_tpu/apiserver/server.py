@@ -617,6 +617,29 @@ class APIServer:
                 obj = await self.admission.admit(obj, resource, "update")
             return _object_response(
                 request, await self.store.update(resource, obj))
+        if request.method == "PATCH" and "apply-patch" in \
+                request.headers.get("Content-Type", ""):
+            # Server-side apply (application/apply-patch+yaml): the
+            # fieldManager param names the owner; force transfers
+            # conflicting fields (SURVEY §2.7).
+            obj = await request.json()
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("name", request.match_info["name"])
+            if request["namespace"]:
+                meta.setdefault("namespace", request["namespace"])
+            manager = request.query.get("fieldManager", "")
+            if not manager:
+                return web.json_response(_status_body(
+                    400, "BadRequest", "fieldManager is required"),
+                    status=400)
+            if self.admission is not None:
+                obj = await self.admission.admit(obj, resource, "update")
+            out = await self.store.apply(
+                resource, obj, field_manager=manager,
+                force=request.query.get("force") in ("true", "1"))
+            # 200 for both create and update (the reference 201s fresh
+            # creates; callers here key off the object, not the code).
+            return _object_response(request, out)
         if request.method == "DELETE":
             uid = None
             if request.can_read_body:
@@ -633,7 +656,7 @@ class APIServer:
             return web.json_response(
                 await self.store.delete(resource, key, uid=uid))
         raise web.HTTPMethodNotAllowed(
-            request.method, ["GET", "PUT", "DELETE"])
+            request.method, ["GET", "PUT", "PATCH", "DELETE"])
 
     async def _sub(self, request: web.Request) -> web.Response:
         proxied = await self._maybe_proxy(request)

@@ -79,7 +79,7 @@ _EXC_OF = {v: k for k, v in _REASON_OF.items()}
 
 _VERB_OF = {"create": "create", "get": "get", "update": "update",
             "delete": "delete", "sub": "update", "list": "list",
-            "watch": "watch", "kinds": "get"}
+            "watch": "watch", "kinds": "get", "apply": "patch"}
 
 _dumps = json.dumps
 
@@ -363,6 +363,13 @@ class _Conn(asyncio.Protocol):
         if op == "sub":
             return await store.subresource(
                 frame[2], frame[3], frame[4], frame[5])
+        if op == "apply":
+            resource, obj = frame[2], frame[3]
+            if admission is not None:
+                obj = await admission.admit(obj, resource, "update")
+            return await store.apply(
+                resource, obj, field_manager=frame[4],
+                force=bool(frame[5] if len(frame) > 5 else False))
         if op == "list":
             resource, args = frame[2], frame[3] or {}
             sel = parse_selector(args["selector"]) \
@@ -785,6 +792,11 @@ class WireStore:
     async def subresource(self, resource: str, key: str, sub: str,
                           body: Mapping) -> dict:
         return await self._call("sub", resource, key, sub, dict(body))
+
+    async def apply(self, resource: str, obj: Mapping, *,
+                    field_manager: str, force: bool = False) -> dict:
+        return await self._call("apply", resource, dict(obj),
+                                field_manager, force)
 
     async def guaranteed_update(
         self, resource: str, key: str,

@@ -201,6 +201,21 @@ async def cmd_apply(store, args, out) -> int:
         meta = obj.setdefault("metadata", {})
         if not _cluster_scoped(store, resource):
             meta.setdefault("namespace", args.namespace)
+        if getattr(args, "server_side", False):
+            # kubectl apply --server-side: field ownership + conflicts
+            # live on the server (store/apply.py).
+            from kubernetes_tpu.store.mvcc import Conflict
+            try:
+                await store.apply(
+                    resource, obj,
+                    field_manager=getattr(args, "field_manager", "kubectl"),
+                    force=getattr(args, "force_conflicts", False))
+                print(f"{resource}/{meta.get('name')} serverside-applied",
+                      file=out)
+            except Conflict as e:
+                print(f"Error: {e}", file=sys.stderr)
+                rc = 1
+            continue
         key = _key(store, resource, meta.get("name", ""),
                    meta.get("namespace", args.namespace))
         try:
@@ -390,6 +405,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
+    a.add_argument("--server-side", action="store_true",
+                   help="server-side apply: declarative field ownership "
+                        "with managedFields + conflict detection")
+    a.add_argument("--field-manager", default="kubectl",
+                   help="field owner name for --server-side")
+    a.add_argument("--force-conflicts", action="store_true",
+                   help="take ownership of conflicting fields")
     a.set_defaults(fn=cmd_apply)
 
     rm = sub.add_parser("delete")

@@ -15,6 +15,7 @@ from kubernetes_tpu.store.mvcc import (
     binding_subresource,
     new_cluster_store,
 )
+from kubernetes_tpu.store.apply import ApplyConflict, server_side_apply
 from kubernetes_tpu.store.durable import (
     DurabilityManager,
     WriteAheadLog,
@@ -23,6 +24,8 @@ from kubernetes_tpu.store.durable import (
 from kubernetes_tpu.store.validation import install_core_validation
 
 __all__ = [
+    "ApplyConflict",
+    "server_side_apply",
     "DurabilityManager",
     "WriteAheadLog",
     "recover_store",

@@ -530,6 +530,14 @@ class MVCCStore:
             self._bookmark_task.cancel()
             self._bookmark_task = None
 
+    async def apply(self, resource: str, obj: Mapping, *,
+                    field_manager: str, force: bool = False) -> dict:
+        """Server-side apply (store/apply.py): declarative field
+        ownership with managedFields + conflict detection."""
+        from kubernetes_tpu.store.apply import server_side_apply
+        return await server_side_apply(
+            self, resource, obj, field_manager=field_manager, force=force)
+
     # -- subresources ------------------------------------------------------
 
     async def subresource(self, resource: str, key: str, sub: str, body: Mapping) -> dict:
