@@ -290,7 +290,7 @@ def build_scheduler(store, config=None, *, feature_gates: FeatureGate | None = N
         if backend is None:
             from kubernetes_tpu.ops import TPUBackend
             backend = TPUBackend()
-        sched.backend = backend
+        sched.attach_backend(backend)
         sched.backend_profiles = backend_profiles
     if cfg.leader_elect:
         # leaderElection.leaderElect: true → the caller runs the scheduler

@@ -200,6 +200,13 @@ class SchedulerMetrics:
             "Pods added to queues", labels=("event", "queue"))
         self.goroutines = r.gauge(
             "scheduler_goroutines", "Concurrent binding tasks", labels=("operation",))
+        #: §5.5 explainability for the TPU backend's silent fallbacks:
+        #: kind="spread_poisoned" (device spread template fell back to
+        #: host rows), kind="gang_overflow" (gangs beyond the solver's
+        #: capacity degrade to Permit-barrier-only atomicity).
+        self.backend_degradations = r.counter(
+            "scheduler_tpu_backend_degradations_total",
+            "TPU backend fallbacks to degraded modes", labels=("kind",))
 
     def observe_plugin(self, plugin: str, point: str, seconds: float) -> None:
         self.plugin_duration.observe(seconds, plugin=plugin, extension_point=point)

@@ -246,6 +246,9 @@ class TPUBackend:
         #: and priority-block-stable permutations keep priority fairness.
         self.multistart = max(1, int(multistart))
         self._pinned_resources = list(resources) if resources else None
+        #: SchedulerMetrics, injected by the Scheduler — degradation
+        #: counters (spread poisoning, gang overflow) report through it.
+        self.metrics = None
         # Multi-device: shard the nodes axis over an ICI mesh
         # (SURVEY §5.7 — the TP-like axis). Inputs are placed with
         # NamedSharding and the SAME jit program auto-partitions (XLA
@@ -686,7 +689,18 @@ class TPUBackend:
 
         # Fallback: poison + host rows + stateful verify (the pre-template
         # behavior). In-flight scan-trusted chunks get host re-checked at
-        # verify time via the poisoned flag.
+        # verify time via the poisoned flag. This cliff is a perf trap
+        # (one heterogeneous pod drops the whole batch's spread work to
+        # host rows) — make it observable, never silent.
+        if not ctx.spread_poisoned:
+            logger.warning(
+                "PodTopologySpread device template POISONED for this "
+                "batch (%d spread pods fall back to host rows): "
+                "heterogeneous constraints/labels or ineligible nodes",
+                len(spread_pods))
+            if self.metrics is not None:
+                self.metrics.backend_degradations.inc(
+                    kind="spread_poisoned")
         ctx.spread_poisoned = True
         for i, pi, cs in spread_pods:
             if not any(c.get("namespaceSelector") for c in cs):
@@ -1366,6 +1380,18 @@ class TPUBackend:
             if groups:
                 gang_onehot = np.zeros((P, _GANG_PAD), dtype=np.float32)
                 gang_required = np.zeros((_GANG_PAD,), dtype=np.float32)
+                if len(groups) > _GANG_PAD:
+                    # Overflow gangs lose in-solver all-or-nothing and
+                    # fall back to the Permit barrier alone — weaker
+                    # atomicity under contention; observable, not silent.
+                    logger.warning(
+                        "%d gangs in chunk exceed solver capacity %d; "
+                        "%d gangs degrade to Permit-barrier-only "
+                        "atomicity", len(groups), _GANG_PAD,
+                        len(groups) - _GANG_PAD)
+                    if self.metrics is not None:
+                        self.metrics.backend_degradations.inc(
+                            len(groups) - _GANG_PAD, kind="gang_overflow")
                 for g, (gk, idxs) in enumerate(groups.items()):
                     if g >= _GANG_PAD:
                         break  # overflow gangs: Permit barrier only

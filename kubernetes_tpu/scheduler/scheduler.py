@@ -116,7 +116,9 @@ class Scheduler:
         #: step-by-step latency trace (SURVEY §5.1).
         self.trace_threshold_ms = trace_threshold_ms
         self.rng = random.Random(seed)
-        self.backend = backend  # TPU batch backend; None = host path
+        self.backend = None  # TPU batch backend; None = host path
+        if backend is not None:
+            self.attach_backend(backend)
         #: Profiles the batched backend serves (TPUScorer gate, per-profile);
         #: None = all profiles (constructor-injected backend, old behavior).
         self.backend_profiles: set[str] | None = None
@@ -236,6 +238,14 @@ class Scheduler:
             if handlers:
                 factory.informer(resource).add_event_handler(
                     ResourceEventHandler(**handlers))
+
+    def attach_backend(self, backend) -> None:
+        """Attach the batched backend — the ONE place its cross-wiring
+        (degradation metrics, §5.5) happens, for both constructor
+        injection and config-built schedulers."""
+        self.backend = backend
+        if backend is not None and hasattr(backend, "metrics"):
+            backend.metrics = self.metrics
 
     def _responsible(self, pi: PodInfo) -> bool:
         return pi.scheduler_name in self.profiles

@@ -132,3 +132,43 @@ class TestGangScheduling:
             factory.stop()
             store.stop()
         run(body())
+
+
+class TestGangOverflowObservability:
+    def test_gang_overflow_counter_fires(self):
+        """More gangs in one chunk than the solver's capacity (_GANG_PAD):
+        overflow gangs degrade to Permit-barrier-only atomicity and the
+        degradation counter records exactly how many."""
+        async def body():
+            from kubernetes_tpu.ops.backend import _GANG_PAD
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(8):
+                await store.create("nodes", make_node(
+                    f"n{i}", allocatable={"cpu": "64", "memory": "64Gi",
+                                          "pods": "110"}))
+            n_gangs = _GANG_PAD + 4
+            for g in range(n_gangs):
+                await store.create(
+                    "podgroups", make_pod_group(f"gang{g}", min_member=2))
+            backend = TPUBackend(max_batch=64)
+            sched, factory = await make_sched(store, backend=backend)
+            run_task = asyncio.ensure_future(sched.run(batch_size=64))
+            for g in range(n_gangs):
+                for m in range(2):
+                    await store.create("pods", gang_pod(
+                        f"g{g}-{m}", f"gang{g}", cpu="100m"))
+            want = {f"g{g}-{m}" for g in range(n_gangs) for m in range(2)}
+            for _ in range(400):
+                if want <= await bound_names(store):
+                    break
+                await asyncio.sleep(0.02)
+            assert want <= await bound_names(store), "gangs did not bind"
+            overflow = sched.metrics.backend_degradations.value(
+                kind="gang_overflow")
+            assert overflow >= 4, f"overflow counter = {overflow}"
+            await sched.stop()
+            run_task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())

@@ -205,3 +205,60 @@ class TestProfilerHook:
         ok = backend.start_profile(str(tmp_path / "trace"))
         backend.stop_profile()
         assert ok in (True, False)  # no exception either way
+
+
+class TestBackendDegradationMetrics:
+    """§5.5: the TPU backend's silent fallbacks are observable — spread
+    template poisoning here; gang overflow in test_coscheduling."""
+
+    def test_spread_poisoning_increments_counter(self):
+        async def body():
+            import asyncio
+
+            from kubernetes_tpu.api.types import make_node, make_pod
+            from kubernetes_tpu.client import InformerFactory
+            from kubernetes_tpu.ops import TPUBackend
+            from kubernetes_tpu.scheduler import Scheduler
+            from kubernetes_tpu.store import (
+                install_core_validation,
+                new_cluster_store,
+            )
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(4):
+                await store.create("nodes", make_node(
+                    f"n{i}",
+                    labels={"topology.kubernetes.io/zone": f"z{i % 2}"}))
+            sched = Scheduler(store, seed=4, backend=TPUBackend(max_batch=16))
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            run_task = asyncio.ensure_future(sched.run(batch_size=16))
+
+            def spread_pod(name, app, skew):
+                return make_pod(name, labels={"app": app},
+                                topology_spread_constraints=[{
+                                    "maxSkew": skew,
+                                    "topologyKey":
+                                        "topology.kubernetes.io/zone",
+                                    "whenUnsatisfiable": "DoNotSchedule",
+                                    "labelSelector": {
+                                        "matchLabels": {"app": app}}}])
+            # Two DIFFERENT spread templates pending together: the device
+            # template cannot stay homogeneous → poisons.
+            for i in range(4):
+                await store.create("pods", spread_pod(f"a{i}", "a", 1))
+                await store.create("pods", spread_pod(f"b{i}", "b", 2))
+            for _ in range(300):
+                pods = (await store.list("pods")).items
+                if sum(1 for p in pods if p["spec"].get("nodeName")) == 8:
+                    break
+                await asyncio.sleep(0.02)
+            assert sched.metrics.backend_degradations.value(
+                kind="spread_poisoned") >= 1
+            await sched.stop()
+            run_task.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
