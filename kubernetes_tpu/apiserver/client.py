@@ -95,6 +95,17 @@ class RemoteStore:
             self._session = aiohttp.ClientSession(headers=self._headers)
         return self._session
 
+    @staticmethod
+    def _trace_headers() -> dict | None:
+        """W3C traceparent propagation: a write issued inside a span
+        (e.g. the binding POST inside scheduler.bind) parents the
+        server-side request span to it."""
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        if not DEFAULT_TRACER.enabled:
+            return None
+        tp = DEFAULT_TRACER.current_traceparent()
+        return {"traceparent": tp} if tp else None
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
@@ -181,7 +192,8 @@ class RemoteStore:
     async def create(self, resource: str, obj: Mapping, **_kw) -> dict:
         ns = obj.get("metadata", {}).get("namespace")
         async with self._sess().post(
-                self._collection_url(resource, ns), json=dict(obj)) as resp:
+                self._collection_url(resource, ns), json=dict(obj),
+                headers=self._trace_headers()) as resp:
             return await self._json(resp)
 
     async def get(self, resource: str, key: str) -> dict:
@@ -217,7 +229,9 @@ class RemoteStore:
     async def subresource(self, resource: str, key: str, sub: str,
                           body: Mapping) -> dict:
         url = self._item_url(resource, key) + "/" + sub
-        async with self._sess().post(url, json=dict(body)) as resp:
+        async with self._sess().post(
+                url, json=dict(body),
+                headers=self._trace_headers()) as resp:
             return await self._json(resp)
 
     async def apply(self, resource: str, obj: Mapping, *,

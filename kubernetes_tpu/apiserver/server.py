@@ -68,19 +68,26 @@ def _status_body(code: int, reason: str, message: str) -> dict:
             "reason": reason, "code": code, "message": message}
 
 
-def _error_response(exc: StoreError) -> web.Response:
+def _code_reason(exc: Exception) -> tuple[int, str]:
     if isinstance(exc, NotFound):
-        code, reason = 404, "NotFound"
-    elif isinstance(exc, AlreadyExists):
-        code, reason = 409, "AlreadyExists"
-    elif isinstance(exc, Conflict):
-        code, reason = 409, "Conflict"
-    elif isinstance(exc, Invalid):
-        code, reason = 422, "Invalid"
-    elif isinstance(exc, Expired):
-        code, reason = 410, "Expired"
-    else:
-        code, reason = 500, "InternalError"
+        return 404, "NotFound"
+    if isinstance(exc, AlreadyExists):
+        return 409, "AlreadyExists"
+    if isinstance(exc, Conflict):
+        return 409, "Conflict"
+    if isinstance(exc, Invalid):
+        return 422, "Invalid"
+    if isinstance(exc, Expired):
+        return 410, "Expired"
+    if isinstance(exc, web.HTTPException):
+        return exc.status, type(exc).__name__
+    if isinstance(exc, (ValueError, json.JSONDecodeError)):
+        return 400, "BadRequest"
+    return 500, "InternalError"
+
+
+def _error_response(exc: StoreError) -> web.Response:
+    code, reason = _code_reason(exc)
     return web.json_response(_status_body(code, reason, str(exc)), status=code)
 
 
@@ -202,7 +209,8 @@ class APIServer:
                  authorizer=None,
                  admission=None,
                  metrics_registry=None,
-                 audit_log: bool = False):
+                 audit_log: bool = False,
+                 tracer=None):
         self.store = store
         self.host = host
         self.port = port
@@ -229,6 +237,12 @@ class APIServer:
         self.admission = admission
         self.metrics_registry = metrics_registry
         self.audit_log = audit_log
+        #: OTel-style request spans (SURVEY §5.1); defaults to the
+        #: process tracer, which is disabled unless someone enables it.
+        if tracer is None:
+            from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+            tracer = DEFAULT_TRACER
+        self.tracer = tracer
         self._runner: web.AppRunner | None = None
         self._proxy_session = None  # shared aggregator proxy client
         self.app = self._build_app()
@@ -239,6 +253,7 @@ class APIServer:
         app = web.Application(middlewares=[
             self._mw_recovery,        # WithPanicRecovery
             self._mw_request_info,    # WithRequestInfo
+            self._mw_trace,           # WithTracing (OTel spans, §5.1)
             self._mw_authn,           # WithAuthentication
             self._mw_priority,        # WithPriorityAndFairness
             self._mw_audit,           # WithAudit (records authz denials)
@@ -309,6 +324,30 @@ class APIServer:
         return await handler(request)
 
     @web.middleware
+    async def _mw_trace(self, request: web.Request, handler):
+        t = self.tracer
+        if t is None or not t.enabled:
+            return await handler(request)
+        attrs = {"client": request.headers.get("User-Agent", "")}
+        if request["resource"] == "pods" and request.match_info.get("name"):
+            ns = request["namespace"] or "default"
+            attrs["pod"] = f"{ns}/{request.match_info['name']}"
+        with t.span(
+                f"apiserver.{request['verb']}.{request['resource'] or 'misc'}",
+                traceparent=request.headers.get("traceparent"),
+                **attrs) as sp:
+            try:
+                resp = await handler(request)
+            except Exception as e:
+                # _mw_recovery (outside this span) will map the
+                # exception; record the status HERE or every failed
+                # request's span reads like a success in Perfetto.
+                sp.attrs["status"] = _code_reason(e)[0]
+                raise
+            sp.attrs["status"] = resp.status
+            return resp
+
+    @web.middleware
     async def _mw_authn(self, request: web.Request, handler):
         user = "system:anonymous"
         auth = request.headers.get("Authorization", "")
@@ -325,6 +364,7 @@ class APIServer:
                         status=401)
                 user = "system:anonymous"
         request["user"] = user
+        self.tracer.annotate(user=user)  # identity, not client library
         return await handler(request)
 
     def _groups_for(self, user: str) -> list[str]:
@@ -591,9 +631,17 @@ class APIServer:
                     "metadata", {}).get("namespace"):
                 obj.setdefault("metadata", {})["namespace"] = \
                     request["namespace"]
+            if resource == "pods":
+                meta = obj.get("metadata") or {}
+                ns = meta.get("namespace") or "default"
+                self.tracer.annotate(pod=f"{ns}/{meta.get('name', '')}")
             if self.admission is not None:
-                obj = await self.admission.admit(obj, resource, "create")
-            created = await self.store.create(resource, obj)
+                with self.tracer.span("admission.webhooks",
+                                      resource=resource, op="create"):
+                    obj = await self.admission.admit(
+                        obj, resource, "create")
+            with self.tracer.span("store.create", resource=resource):
+                created = await self.store.create(resource, obj)
             return _object_response(request, created, status=201)
         raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST"])
 
@@ -687,7 +735,9 @@ class APIServer:
         if request.method != "POST":
             raise web.HTTPMethodNotAllowed(request.method, ["POST"])
         body = await request.json()
-        result = await self.store.subresource(resource, key, sub, body)
+        with self.tracer.span(f"store.subresource.{sub}",
+                              resource=resource):
+            result = await self.store.subresource(resource, key, sub, body)
         return web.json_response(result, status=201)
 
     async def _watch(self, request: web.Request) -> web.StreamResponse:

@@ -85,6 +85,7 @@ class Scheduler:
         pod_initial_backoff: float = 1.0,
         pod_max_backoff: float = 10.0,
         trace_threshold_ms: float = 100.0,
+        tracer=None,
     ):
         self.store = store
         self.metrics = metrics or SchedulerMetrics()
@@ -116,6 +117,10 @@ class Scheduler:
         #: step-by-step latency trace (SURVEY §5.1).
         self.trace_threshold_ms = trace_threshold_ms
         self.rng = random.Random(seed)
+        #: OTel-style spans (§5.1); same default process tracer as the
+        #: apiserver so one tracer assembles the whole pod journey.
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        self.tracer = tracer if tracer is not None else DEFAULT_TRACER
         self.backend = None  # TPU batch backend; None = host path
         if backend is not None:
             self.attach_backend(backend)
@@ -587,6 +592,15 @@ class Scheduler:
             logger.error("no profile for schedulerName=%s", pi.scheduler_name)
             await self.queue.done(pi.key)
             return
+        if self.tracer.enabled:
+            with self.tracer.span("scheduler.attempt", pod=pi.key,
+                                  profile=fwk.profile_name):
+                return await self._schedule_host_path_traced(
+                    pi, snapshot, fwk)
+        await self._schedule_host_path_traced(pi, snapshot, fwk)
+
+    async def _schedule_host_path_traced(self, pi: PodInfo, snapshot,
+                                         fwk) -> None:
         state = CycleState()
         t0 = time.perf_counter()
         try:
@@ -642,6 +656,18 @@ class Scheduler:
     async def _binding_cycle(self, fwk: Framework, state: CycleState, pi: PodInfo,
                              node_name: str, permit_status: Status,
                              timeout: float) -> None:
+        if self.tracer.enabled:
+            with self.tracer.span("scheduler.bind", pod=pi.key,
+                                  node=node_name):
+                return await self._binding_cycle_traced(
+                    fwk, state, pi, node_name, permit_status, timeout)
+        await self._binding_cycle_traced(
+            fwk, state, pi, node_name, permit_status, timeout)
+
+    async def _binding_cycle_traced(self, fwk: Framework, state: CycleState,
+                                    pi: PodInfo, node_name: str,
+                                    permit_status: Status,
+                                    timeout: float) -> None:
         bound = False
         try:
             if permit_status.is_wait():

@@ -1,0 +1,164 @@
+"""OTel-style request tracing (SURVEY §5.1 component-base/tracing).
+
+A lightweight in-process tracer: spans with trace/span ids, parentage
+via contextvars (so nested awaits auto-parent), W3C `traceparent`
+propagation for cross-component HTTP hops, and export to the Chrome
+trace-event JSON that Perfetto (and chrome://tracing) loads — the same
+timeline family the jax profiler emits, so a control-plane trace and a
+device trace can sit side by side.
+
+Where spans come from:
+- APIServer: one span per request (verb/resource/user/status), child
+  spans for store ops and admission webhook out-calls;
+- Scheduler: a span per scheduling attempt and per binding cycle,
+  attributed with the pod key;
+- anything else via `TRACER.span(...)` / `aspan(...)`.
+
+The pod's journey (create → schedule → bind) crosses async boundaries
+the context can't follow (informer → queue → cycle), so spans carry a
+`pod` attribute and `trace_for(pod_key)` assembles the cross-component
+story — the reference's kube-apiserver + kube-scheduler traces joined
+on object identity.
+
+Disabled by default: a disabled tracer's span() is a no-op costing one
+attribute check, so the hot paths stay clean (utiltrace remains the
+always-on threshold logger).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import time
+from typing import Any
+
+_ids = itertools.count(1)
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "ktpu_current_span", default=None)
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "attrs")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: str | None, attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.monotonic()
+        self.end: float | None = None
+        self.attrs = attrs
+
+    @property
+    def duration_ms(self) -> float:
+        return 1000.0 * ((self.end or time.monotonic()) - self.start)
+
+
+class Tracer:
+    """Span collector. Bounded ring (oldest spans drop) so an always-on
+    tracer can't grow without limit."""
+
+    def __init__(self, enabled: bool = False, max_spans: int = 65536):
+        self.enabled = enabled
+        self.max_spans = max_spans
+        self.spans: list[Span] = []
+
+    # -- span creation -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, traceparent: str | None = None,
+             **attrs: Any):
+        """Sync/async-agnostic context manager (works under `async with
+        tracer.aspan(...)` too via the wrapper below)."""
+        if not self.enabled:
+            yield None
+            return
+        parent = _current.get()
+        if traceparent:
+            trace_id, parent_id = _parse_traceparent(traceparent)
+        elif parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = f"t{next(_ids):016x}", None
+        sp = Span(name, trace_id, f"s{next(_ids):08x}", parent_id, attrs)
+        self.spans.append(sp)
+        if len(self.spans) > self.max_spans:
+            del self.spans[: len(self.spans) - self.max_spans]
+        token = _current.set(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = time.monotonic()
+            _current.reset(token)
+
+    @contextlib.asynccontextmanager
+    async def aspan(self, name: str, **kw):
+        with self.span(name, **kw) as sp:
+            yield sp
+
+    def annotate(self, **attrs: Any) -> None:
+        """Attach attributes to the CURRENT span (e.g. the pod key a
+        create request turns out to be about, known only after the body
+        parses)."""
+        if not self.enabled:
+            return
+        sp = _current.get()
+        if sp is not None:
+            sp.attrs.update(attrs)
+
+    def current_traceparent(self) -> str | None:
+        sp = _current.get()
+        if sp is None:
+            return None
+        return format_traceparent(sp.trace_id, sp.span_id)
+
+    # -- queries + export --------------------------------------------------
+
+    def trace_for(self, pod_key: str) -> list[Span]:
+        """Every span attributed to one pod, time-ordered — the
+        cross-component create→schedule→bind story."""
+        return sorted((s for s in self.spans
+                       if s.attrs.get("pod") == pod_key),
+                      key=lambda s: s.start)
+
+    def to_perfetto(self) -> str:
+        """Chrome trace-event JSON (Perfetto/chrome://tracing/the jax
+        profiler's timeline family). Complete ('X') events in µs."""
+        events = []
+        for s in self.spans:
+            if s.end is None:
+                continue
+            events.append({
+                "name": s.name, "ph": "X", "pid": 1,
+                "tid": abs(hash(s.trace_id)) % 100_000,
+                "ts": round(s.start * 1e6, 3),
+                "dur": round((s.end - s.start) * 1e6, 3),
+                "args": {**{k: str(v) for k, v in s.attrs.items()},
+                         "trace_id": s.trace_id, "span_id": s.span_id,
+                         **({"parent_id": s.parent_id}
+                            if s.parent_id else {})},
+            })
+        return json.dumps({"traceEvents": events}, separators=(",", ":"))
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    # W3C shape (version-trace-parent-flags); ids are our own tokens.
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def _parse_traceparent(header: str) -> tuple[str, str | None]:
+    parts = header.split("-")
+    if len(parts) >= 3:
+        return parts[1], parts[2]
+    return f"t{next(_ids):016x}", None
+
+
+#: process-wide default; enable with DEFAULT_TRACER.enabled = True.
+DEFAULT_TRACER = Tracer(enabled=False)

@@ -262,3 +262,90 @@ class TestBackendDegradationMetrics:
             factory.stop()
             store.stop()
         run(body())
+
+
+class TestRequestTracing:
+    """§5.1 OTel-style spans: one trace covers a pod's create → schedule
+    → bind across the apiserver and scheduler, exportable to Perfetto."""
+
+    def test_pod_journey_trace_and_perfetto_export(self):
+        async def body():
+            import asyncio
+            import json as _json
+
+            from kubernetes_tpu.api.types import make_node, make_pod
+            from kubernetes_tpu.apiserver import APIServer, RemoteStore
+            from kubernetes_tpu.client import InformerFactory
+            from kubernetes_tpu.scheduler import Scheduler
+            from kubernetes_tpu.store import (
+                install_core_validation,
+                new_cluster_store,
+            )
+            from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+            DEFAULT_TRACER.enabled = True
+            DEFAULT_TRACER.clear()
+            try:
+                backing = new_cluster_store()
+                install_core_validation(backing)
+                srv = APIServer(backing)
+                await srv.start()
+                rs = RemoteStore(srv.url)
+                await rs.create("nodes", make_node("n0"))
+                sched = Scheduler(rs, seed=9)
+                factory = InformerFactory(rs)
+                await sched.setup_informers(factory)
+                factory.start()
+                await factory.wait_for_sync()
+                run_task = asyncio.ensure_future(sched.run(batch_size=4))
+                await rs.create("pods", make_pod("traced"))
+                for _ in range(300):
+                    p = await rs.get("pods", "default/traced")
+                    if p["spec"].get("nodeName"):
+                        break
+                    await asyncio.sleep(0.02)
+                assert p["spec"].get("nodeName") == "n0"
+                await sched.stop()
+                run_task.cancel()
+                factory.stop()
+                await rs.close()
+                await srv.stop()
+                backing.stop()
+
+                journey = DEFAULT_TRACER.trace_for("default/traced")
+                names = [s.name for s in journey]
+                # create request, scheduling attempt, binding cycle, and
+                # the binding POST back through the apiserver — ordered.
+                assert "apiserver.create.pods" in names, names
+                assert "scheduler.attempt" in names, names
+                assert "scheduler.bind" in names, names
+                assert names.index("apiserver.create.pods") \
+                    < names.index("scheduler.attempt") \
+                    < names.index("scheduler.bind"), names
+                # the binding POST is a second pod-attributed apiserver
+                # span after the bind began
+                api_spans = [s for s in journey
+                             if s.name.startswith("apiserver.")]
+                assert len(api_spans) >= 2, names
+                # W3C traceparent propagation: the binding POST's server
+                # span belongs to scheduler.bind's TRACE (same trace_id),
+                # not a fresh one.
+                bind = next(s for s in journey
+                            if s.name == "scheduler.bind")
+                bind_post = next(
+                    (s for s in api_spans
+                     if s.start >= bind.start and s.trace_id ==
+                     bind.trace_id), None)
+                assert bind_post is not None, [
+                    (s.name, s.trace_id) for s in journey]
+                assert all(s.end is not None for s in journey)
+                # Perfetto export round-trips
+                doc = _json.loads(DEFAULT_TRACER.to_perfetto())
+                evs = doc["traceEvents"]
+                assert any(e["name"] == "scheduler.bind" for e in evs)
+                assert any(e["name"] == "store.subresource.binding"
+                           for e in evs)
+                assert all("ts" in e and "dur" in e for e in evs)
+            finally:
+                DEFAULT_TRACER.enabled = False
+                DEFAULT_TRACER.clear()
+        run(body())
