@@ -347,10 +347,25 @@ async def cmd_drain(store, args, out) -> int:
 
 
 async def cmd_top(store, args, out) -> int:
-    """top nodes: requested/allocatable per node (the scheduler's view —
+    """top nodes|pods: requested/allocatable (the scheduler's view —
     there is no metrics-server; requests are the capacity signal here)."""
     from kubernetes_tpu.api.resource import format_quantity, parse_quantity
     from kubernetes_tpu.api.types import pod_is_terminal, pod_requests
+    if args.what == "pods":
+        rows = []
+        for p in (await store.list(
+                "pods", namespace=args.namespace)).items:
+            if pod_is_terminal(p):
+                continue
+            reqs = pod_requests(p)
+            rows.append([
+                p["metadata"]["name"],
+                format_quantity(reqs.get("cpu", 0)),
+                format_quantity(reqs.get("memory", 0)),
+                p.get("spec", {}).get("nodeName", "<none>"),
+            ])
+        _print_table(["NAME", "CPU(req)", "MEM(req)", "NODE"], rows, out)
+        return 0
     nodes = (await store.list("nodes")).items
     pods = (await store.list("pods")).items
     used: dict[str, dict[str, int]] = {}
@@ -379,6 +394,76 @@ async def cmd_top(store, args, out) -> int:
     _print_table(["NAME", "CPU(req/alloc)", "CPU%",
                   "MEM(req/alloc)", "MEM%"], rows, out)
     return 0
+
+
+
+async def cmd_rollout(store, args, out) -> int:
+    """rollout status|restart|history for deployments (kubectl rollout).
+
+    status: observedGeneration + updated/ready vs desired (the reference
+    rollout_status.go readiness math); restart: stamps
+    kubectl.kubernetes.io/restartedAt into the pod template, which hashes
+    to a new revision and rolls every pod (kubectl rollout restart).
+    """
+    from kubernetes_tpu.api.meta import now_iso
+    if args.resource not in ("deployment", "deployments"):
+        print("Error: rollout supports deployments", file=sys.stderr)
+        return 1
+    key = _key(store, "deployments", args.name, args.namespace)
+    try:
+        dep = await store.get("deployments", key)
+    except NotFound:
+        print(f"Error: deployment {args.name!r} not found", file=sys.stderr)
+        return 1
+    if args.action == "status":
+        spec = dep.get("spec") or {}
+        status = dep.get("status") or {}
+        desired = int(spec.get("replicas", 1))
+        updated = int(status.get("updatedReplicas", 0))
+        ready = int(status.get("readyReplicas", 0))
+        gen_ok = int(status.get("observedGeneration", 0)) >= \
+            int(dep["metadata"].get("generation", 0) or 0)
+        if gen_ok and updated == desired and ready == desired:
+            print(f'deployment "{args.name}" successfully rolled out',
+                  file=out)
+            return 0
+        print(f"Waiting for deployment {args.name!r} rollout to finish: "
+              f"{updated} out of {desired} new replicas have been "
+              f"updated, {ready} ready...", file=out)
+        return 3  # kubectl's non-zero while in progress (watch loop)
+    if args.action == "restart":
+        stamp = now_iso()
+
+        def bump(obj):
+            tmpl = obj.setdefault("spec", {}).setdefault("template", {})
+            md = tmpl.setdefault("metadata", {})
+            md.setdefault("annotations", {})[
+                "kubectl.kubernetes.io/restartedAt"] = stamp
+            return obj
+        await store.guaranteed_update("deployments", key, bump,
+                                      return_copy=False)
+        print(f"deployment.apps/{args.name} restarted", file=out)
+        return 0
+    if args.action == "history":
+        rss = (await store.list("replicasets",
+                                namespace=args.namespace)).items
+        rows = []
+        for rs in rss:
+            for ref in rs["metadata"].get("ownerReferences") or []:
+                if ref.get("kind") == "Deployment" and \
+                        ref.get("name") == args.name:
+                    rows.append([
+                        rs["metadata"].get("annotations", {}).get(
+                            "deployment.kubernetes.io/revision", "?"),
+                        rs["metadata"]["name"],
+                        str(rs.get("spec", {}).get("replicas", 0)),
+                    ])
+        rows.sort(key=lambda r: int(r[0]) if r[0].isdigit() else 1 << 30)
+        _print_table(["REVISION", "REPLICASET", "REPLICAS"], rows, out)
+        return 0
+    print(f"Error: unknown rollout action {args.action!r}",
+          file=sys.stderr)
+    return 1
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -433,8 +518,14 @@ def build_parser() -> argparse.ArgumentParser:
         c.set_defaults(fn=fn)
 
     t = sub.add_parser("top")
-    t.add_argument("what", choices=["nodes"])
+    t.add_argument("what", choices=["nodes", "pods"])
     t.set_defaults(fn=cmd_top)
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "restart", "history"])
+    ro.add_argument("resource")
+    ro.add_argument("name")
+    ro.set_defaults(fn=cmd_rollout)
     return ap
 
 

@@ -84,6 +84,7 @@ _FILTER_ACTIVE = {
         plugin._constraints_for(pi, "DoNotSchedule")),
     "NodePorts": lambda plugin, pi, snap: bool(pi.host_ports),
     "VolumeBinding": lambda plugin, pi, snap: bool(pi.pvc_names),
+    "VolumeRestrictions": lambda plugin, pi, snap: bool(pi.pvc_names),
     "VolumeZone": lambda plugin, pi, snap: bool(pi.pvc_names),
     "NodeVolumeLimits": lambda plugin, pi, snap: bool(pi.pvc_names),
     "NodeResourceTopologyMatch":

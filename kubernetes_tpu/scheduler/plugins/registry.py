@@ -34,6 +34,7 @@ from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
 from kubernetes_tpu.scheduler.plugins.volumebinding import (
     NodeVolumeLimits,
     VolumeBinding,
+    VolumeRestrictions,
     VolumeZone,
 )
 
@@ -53,6 +54,7 @@ IN_TREE: dict[str, Callable] = {
     "TaintToleration": TaintToleration,
     "NodePorts": NodePorts,
     "VolumeBinding": VolumeBinding,
+    "VolumeRestrictions": VolumeRestrictions,
     "VolumeZone": VolumeZone,
     "NodeVolumeLimits": NodeVolumeLimits,
     "InterPodAffinity": InterPodAffinity,
@@ -72,6 +74,7 @@ DEFAULT_PLUGINS = [
     "NodeAffinity",
     "NodePorts",
     "VolumeBinding",
+    "VolumeRestrictions",
     "VolumeZone",
     "NodeVolumeLimits",
     "NodeResourcesFit",

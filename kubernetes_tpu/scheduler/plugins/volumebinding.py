@@ -332,3 +332,89 @@ class NodeVolumeLimits(Plugin):
             return Status.unschedulable(
                 "node(s) exceed max volume count", resolvable=True)
         return Status.success()
+
+
+class VolumeRestrictions(Plugin):
+    """Volume access-mode conflicts.
+
+    Parity target: plugins/volumerestrictions/ (SURVEY §2.3):
+    - ReadWriteOncePod: a PVC with the ReadWriteOncePod access mode
+      admits exactly ONE consumer pod cluster-wide; a second pod is
+      unschedulable everywhere while the first exists (the reference's
+      conflict count over the PreFilter-computed user set).
+    - ReadWriteOnce: the volume attaches to one NODE at a time; a pod
+      reusing an RWO claim already consumed by a resident pod must land
+      on that pod's node (co-location allowed, cross-node attach not).
+    """
+
+    NAME = "VolumeRestrictions"
+    EXTENSION_POINTS = ("PreFilter", "Filter")
+    EVENTS = ["Pod/Delete", "PersistentVolumeClaim/Add",
+              "PersistentVolumeClaim/Update"]
+
+    _STATE = "VolumeRestrictions/state"
+
+    def __init__(self, args=None):
+        super().__init__(args)
+        self._pvc_informer = None
+
+    def set_informers(self, factory) -> None:
+        self._pvc_informer = factory.informer("persistentvolumeclaims")
+
+    def _access_modes(self, namespace: str, claim: str) -> list[str]:
+        if self._pvc_informer is None:
+            return []
+        pvc = self._pvc_informer.indexer.get(f"{namespace}/{claim}")
+        if pvc is None:
+            return []
+        return (pvc.get("spec") or {}).get("accessModes") or []
+
+    def pre_filter(self, state: CycleState, pod: PodInfo,
+                   snapshot) -> Status:
+        if not pod.pvc_names:
+            return Status.skip()
+        rwop: list[str] = []
+        rwo: list[str] = []
+        for claim in pod.pvc_names:
+            modes = self._access_modes(pod.namespace, claim)
+            if "ReadWriteOncePod" in modes:
+                rwop.append(claim)
+            elif "ReadWriteOnce" in modes:
+                rwo.append(claim)
+        if not rwop and not rwo:
+            return Status.skip()
+        #: claim -> node names of resident pods already using it.
+        users: dict[str, set[str]] = {}
+        watched = set(rwop) | set(rwo)
+        for ni in snapshot:
+            for resident in ni.pods:
+                if resident.namespace != pod.namespace \
+                        or resident.key == pod.key:
+                    continue
+                for claim in resident.pvc_names:
+                    if claim in watched:
+                        users.setdefault(claim, set()).add(ni.name)
+        for claim in rwop:
+            if users.get(claim):
+                return Status.unschedulable(
+                    f"PVC {claim!r} has ReadWriteOncePod access mode and "
+                    "is already used by another pod", resolvable=True)
+        # RWO: intersect the allowed node sets of every in-use claim.
+        allowed: set[str] | None = None
+        for claim in rwo:
+            nodes = users.get(claim)
+            if not nodes:
+                continue
+            allowed = nodes if allowed is None else (allowed & nodes)
+        state.write(self._STATE, allowed)
+        return Status.success()
+
+    def filter(self, state: CycleState, pod: PodInfo, node) -> Status:
+        allowed = state.read(self._STATE)
+        if allowed is None:
+            return Status.success()
+        if node.name in allowed:
+            return Status.success()
+        return Status.unschedulable(
+            "node(s) unavailable: ReadWriteOnce volume is attached to "
+            "another node")

@@ -63,9 +63,12 @@ class Tracer:
     tracer can't grow without limit."""
 
     def __init__(self, enabled: bool = False, max_spans: int = 65536):
+        from collections import deque
         self.enabled = enabled
         self.max_spans = max_spans
-        self.spans: list[Span] = []
+        # deque(maxlen): O(1) ring-buffer appends — a full list ring
+        # would memmove 64k entries per span on the hot path.
+        self.spans: "deque[Span]" = deque(maxlen=max_spans)
 
     # -- span creation -----------------------------------------------------
 
@@ -85,9 +88,7 @@ class Tracer:
         else:
             trace_id, parent_id = f"t{next(_ids):016x}", None
         sp = Span(name, trace_id, f"s{next(_ids):08x}", parent_id, attrs)
-        self.spans.append(sp)
-        if len(self.spans) > self.max_spans:
-            del self.spans[: len(self.spans) - self.max_spans]
+        self.spans.append(sp)  # maxlen ring: oldest drops automatically
         token = _current.set(sp)
         try:
             yield sp

@@ -43,3 +43,15 @@ def event_loop_policy():
 def run_async(coro):
     """Run a coroutine to completion on a fresh loop (test helper)."""
     return asyncio.run(coro)
+
+
+async def start_scheduler(store, seed=42, **kw):
+    """Shared scheduler bootstrap for e2e-style tests."""
+    from kubernetes_tpu.client import InformerFactory
+    from kubernetes_tpu.scheduler import Scheduler
+    sched = Scheduler(store, seed=seed, **kw)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    factory.start()
+    await factory.wait_for_sync()
+    return sched, factory

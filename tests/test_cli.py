@@ -176,3 +176,86 @@ class TestOverHTTP:
             await srv.stop()
             store.stop()
         run(body())
+
+
+class TestRolloutAndTop:
+    def test_rollout_status_restart_history(self):
+        async def body():
+            import io
+
+            from kubernetes_tpu.cli.kubectl import build_parser, run_command
+            from kubernetes_tpu.client import InformerFactory
+            from kubernetes_tpu.controllers import (
+                ControllerManager,
+                DeploymentController,
+                ReplicaSetController,
+                make_deployment,
+            )
+            store = new_cluster_store()
+            install_core_validation(store)
+            await store.create("deployments", make_deployment(
+                "web", 2, {"matchLabels": {"app": "web"}},
+                {"metadata": {"labels": {"app": "web"}},
+                 "spec": {"containers": [
+                     {"name": "main", "image": "app"}]}}))
+            mgr = ControllerManager(store, [
+                DeploymentController(store), ReplicaSetController(store)])
+            await mgr.start()
+
+            async def rollout(*argv):
+                out = io.StringIO()
+                args = build_parser().parse_args(["rollout", *argv])
+                rc = await run_command(store, args, out)
+                return rc, out.getvalue()
+
+            # bind pods (scheduler-sim; readyReplicas counts bound
+            # pods), then wait for the controller to report the rollout
+            await store.create("nodes", make_node("n0"))
+
+            async def bind_all():
+                from kubernetes_tpu.api.meta import namespaced_name
+                for p in (await store.list("pods")).items:
+                    if not p["spec"].get("nodeName"):
+                        try:
+                            await store.subresource(
+                                "pods", namespaced_name(p), "binding",
+                                {"target": {"kind": "Node",
+                                            "name": "n0"}})
+                        except Exception:
+                            pass
+            for _ in range(300):
+                await bind_all()
+                rc, text = await rollout("status", "deployment", "web")
+                if rc == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert rc == 0 and "successfully rolled out" in text
+            rc, text = await rollout("restart", "deployment", "web")
+            assert rc == 0 and "restarted" in text
+            dep = await store.get("deployments", "default/web")
+            assert dep["spec"]["template"]["metadata"]["annotations"][
+                "kubectl.kubernetes.io/restartedAt"]
+            rc, text = await rollout("history", "deployment", "web")
+            assert rc == 0 and "REVISION" in text
+            await mgr.stop()
+            store.stop()
+        run(body())
+
+    def test_top_pods(self):
+        async def body():
+            import io
+
+            from kubernetes_tpu.cli.kubectl import build_parser, run_command
+            store = new_cluster_store()
+            install_core_validation(store)
+            await store.create("pods", make_pod(
+                "busy", requests={"cpu": "500m", "memory": "1Gi"},
+                node_name="n0"))
+            out = io.StringIO()
+            args = build_parser().parse_args(["top", "pods"])
+            rc = await run_command(store, args, out)
+            assert rc == 0
+            text = out.getvalue()
+            assert "busy" in text and "500m" in text and "n0" in text
+            store.stop()
+        run(body())

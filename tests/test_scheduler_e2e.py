@@ -24,13 +24,7 @@ async def make_cluster(num_nodes=5, node_kw=None):
     return store
 
 
-async def start_scheduler(store, **kw):
-    sched = Scheduler(store, seed=42, **kw)
-    factory = InformerFactory(store)
-    await sched.setup_informers(factory)
-    factory.start()
-    await factory.wait_for_sync()
-    return sched, factory
+from tests.conftest import start_scheduler  # noqa: E402
 
 
 async def wait_bound(store, n, timeout=5.0):

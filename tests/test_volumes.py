@@ -280,3 +280,97 @@ class TestVolumeLimits:
                 "second volume exceeded the node's attach limit"
             await teardown()
         run(body())
+
+
+from tests.conftest import start_scheduler  # noqa: E402
+
+
+class TestVolumeRestrictions:
+    """volumerestrictions/ parity: ReadWriteOncePod exclusivity and
+    ReadWriteOnce single-node attachment."""
+
+    async def _mk_bound_pvc(self, store, name, modes):
+        """Pre-bound claim (volumeName set + PV) so VolumeBinding passes
+        without running the PV binder controller."""
+        from kubernetes_tpu.api.meta import new_object
+        await store.create("persistentvolumes", new_object(
+            "PersistentVolume", f"pv-{name}", None,
+            spec={"capacity": {"storage": "1Gi"}, "accessModes": modes}))
+        await store.create("persistentvolumeclaims", new_object(
+            "PersistentVolumeClaim", name, "default",
+            spec={"accessModes": modes, "volumeName": f"pv-{name}",
+                  "resources": {"requests": {"storage": "1Gi"}}}))
+
+    def _pod_with_claim(self, name, claim, node_name=None):
+        pod = make_pod(name, node_name=node_name,
+                       requests={"cpu": "100m"})
+        pod["spec"]["volumes"] = [{
+            "name": "v", "persistentVolumeClaim": {"claimName": claim}}]
+        return pod
+
+    def test_rwop_claim_admits_one_pod(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(2):
+                await store.create("nodes", make_node(f"n{i}"))
+            await self._mk_bound_pvc(store, "exclusive",
+                                          ["ReadWriteOncePod"])
+            sched, factory = await start_scheduler(store)
+            loop = asyncio.ensure_future(sched.run())
+            await store.create("pods",
+                               self._pod_with_claim("first", "exclusive"))
+            for _ in range(200):
+                p = await store.get("pods", "default/first")
+                if p["spec"].get("nodeName"):
+                    break
+                await asyncio.sleep(0.02)
+            assert p["spec"].get("nodeName")
+            await store.create("pods",
+                               self._pod_with_claim("second", "exclusive"))
+            await asyncio.sleep(0.4)
+            p2 = await store.get("pods", "default/second")
+            assert not p2["spec"].get("nodeName"), \
+                "RWOP claim admitted a second pod"
+            # first pod going away releases the claim
+            await store.delete("pods", "default/first")
+            for _ in range(300):
+                p2 = await store.get("pods", "default/second")
+                if p2["spec"].get("nodeName"):
+                    break
+                await asyncio.sleep(0.02)
+            assert p2["spec"].get("nodeName")
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
+
+    def test_rwo_claim_pins_to_the_attached_node(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(3):
+                await store.create("nodes", make_node(f"n{i}"))
+            await self._mk_bound_pvc(store, "shared",
+                                          ["ReadWriteOnce"])
+            # a pod already runs with the claim on n1
+            await store.create(
+                "pods", self._pod_with_claim("holder", "shared",
+                                             node_name="n1"))
+            sched, factory = await start_scheduler(store)
+            loop = asyncio.ensure_future(sched.run())
+            await store.create("pods",
+                               self._pod_with_claim("joiner", "shared"))
+            for _ in range(200):
+                p = await store.get("pods", "default/joiner")
+                if p["spec"].get("nodeName"):
+                    break
+                await asyncio.sleep(0.02)
+            # RWO is node-scoped: the joiner must co-locate on n1
+            assert p["spec"].get("nodeName") == "n1", p["spec"]
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
