@@ -29,12 +29,20 @@ class KwokController(Controller):
     def __init__(self, store, *, node_count: int = 0,
                  node_template: dict | None = None,
                  lease_period: float = 2.0,
-                 name_prefix: str = "kwok-node-"):
+                 name_prefix: str = "kwok-node-",
+                 device_zones: int = 2,
+                 device_driver: str = "dra.ktpu"):
         super().__init__(store)
         self.node_count = node_count
         self.node_template = node_template or {}
         self.lease_period = lease_period
         self.name_prefix = name_prefix
+        #: device-plugin seam (SURVEY §2.5 devicemanager): extended
+        #: resources in the node template ALSO publish as per-node
+        #: ResourceSlices (the DRA driver's ListAndWatch analog), split
+        #: round-robin across this many NUMA zones.
+        self.device_zones = max(1, device_zones)
+        self.device_driver = device_driver
         self._managed: set[str] = set()
         self._ip_seq = 0  # fake pod IP allocator (see _mark_running)
         self._run_queue: list[str] = []
@@ -80,6 +88,44 @@ class KwokController(Controller):
             except AlreadyExists:
                 pass
             self._managed.add(name)
+            await self._publish_devices(name)
+
+    async def _publish_devices(self, node_name: str) -> None:
+        """Model HOW `google.com/tpu: 8` arrives: the kubelet device
+        manager / DRA driver registers the node's devices. Extended
+        resources in the template (names containing '/') publish as a
+        ResourceSlice with per-device NUMA attributes, so BOTH device
+        paths work against kwok nodes — legacy extended-resource counting
+        (already in node.allocatable) and DRA claims."""
+        alloc = self.node_template.get("allocatable") or {}
+        devices = []
+        for res, count in alloc.items():
+            if "/" not in res:
+                continue  # core resources are not devices
+            short = res.rsplit("/", 1)[1]
+            try:
+                n = int(str(count))
+            except ValueError:
+                continue
+            for k in range(n):
+                devices.append({
+                    "name": f"{short}-{k}",
+                    "attributes": {
+                        "type": short,
+                        "numa": str(k * self.device_zones // max(1, n))}})
+        if not devices:
+            return
+        from kubernetes_tpu.api.types import make_resource_slice
+        try:
+            await self.store.create(
+                "resourceslices",
+                make_resource_slice(node_name, self.device_driver,
+                                    devices))
+        except AlreadyExists:
+            pass
+        except StoreError:
+            logger.exception("kwok: device publish failed for %s",
+                             node_name)
 
     def start(self) -> None:
         super().start()

@@ -300,3 +300,62 @@ class TestResourceClaimController(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestKwokDevicePublishing(unittest.TestCase):
+    def test_kwok_nodes_publish_resource_slices(self):
+        """The device-plugin seam: extended resources on kwok nodes also
+        arrive as ResourceSlices (devicemanager/DRA-driver analog), so
+        claim-based pods schedule onto kwok clusters."""
+        async def body():
+            from kubernetes_tpu.controllers import KwokController
+            store = new_cluster_store()
+            install_core_validation(store)
+            await store.create("deviceclasses",
+                               make_device_class("tpu", {"type": "tpu"}))
+            kwok = KwokController(
+                store, node_count=3,
+                node_template={"allocatable": {
+                    "cpu": "16", "memory": "64Gi", "pods": "110",
+                    "google.com/tpu": "8"}},
+                device_zones=2)
+            factory = InformerFactory(store)
+            kwok.setup(factory)
+            sched = Scheduler(store, seed=6)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            await kwok.register_nodes()
+            slices = (await store.list("resourceslices")).items
+            self.assertEqual(len(slices), 3)
+            devices = slices[0]["spec"]["devices"]
+            self.assertEqual(len(devices), 8)
+            self.assertEqual({d["attributes"]["numa"] for d in devices},
+                             {"0", "1"})
+            # a DRA claim schedules against the published inventory
+            kwok.start()
+            run_task = asyncio.ensure_future(sched.run(batch_size=8))
+            await store.create("resourceclaims", make_resource_claim(
+                "want-tpus",
+                requests=[{"name": "t", "deviceClassName": "tpu",
+                           "count": 4}],
+                constraints=[{"matchAttribute": "numa"}]))
+            await store.create("pods", make_pod(
+                "claimer", requests={"cpu": "1"},
+                resource_claims=[{"name": "t",
+                                  "resourceClaimName": "want-tpus"}]))
+            for _ in range(300):
+                p = await store.get("pods", "default/claimer")
+                if p["spec"].get("nodeName"):
+                    break
+                await asyncio.sleep(0.02)
+            self.assertTrue(p["spec"].get("nodeName"))
+            c = await store.get("resourceclaims", "default/want-tpus")
+            self.assertEqual(
+                len(c["status"]["allocation"]["devices"]), 4)
+            await sched.stop()
+            run_task.cancel()
+            await kwok.stop()
+            factory.stop()
+            store.stop()
+        run(body())
