@@ -14,7 +14,7 @@ import asyncio
 import logging
 
 from kubernetes_tpu.api.meta import namespaced_name, new_object
-from kubernetes_tpu.api.types import make_node
+from kubernetes_tpu.api.types import make_node, make_resource_slice
 from kubernetes_tpu.client import InformerFactory, ResourceEventHandler
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.store.mvcc import AlreadyExists, NotFound, StoreError
@@ -40,9 +40,11 @@ class KwokController(Controller):
         #: device-plugin seam (SURVEY §2.5 devicemanager): extended
         #: resources in the node template ALSO publish as per-node
         #: ResourceSlices (the DRA driver's ListAndWatch analog), split
-        #: round-robin across this many NUMA zones.
+        #: into contiguous blocks across this many NUMA zones (devices
+        #: 0..n/z-1 in zone 0, etc. — the alignment MatchAttribute needs).
         self.device_zones = max(1, device_zones)
         self.device_driver = device_driver
+        self._device_list: list[dict] | None = None  # built once
         self._managed: set[str] = set()
         self._ip_seq = 0  # fake pod IP allocator (see _mark_running)
         self._run_queue: list[str] = []
@@ -90,6 +92,34 @@ class KwokController(Controller):
             self._managed.add(name)
             await self._publish_devices(name)
 
+    def _template_devices(self) -> list[dict]:
+        """Device list derived from the template ONCE (50k-node runs
+        register 50k slices; re-parsing per node would be 400k throwaway
+        dict builds). Names carry the FULL resource (dots/slashes → '-')
+        so two vendors' same-suffix resources can't collide in the
+        consumed-device set."""
+        if self._device_list is not None:
+            return self._device_list
+        alloc = self.node_template.get("allocatable") or {}
+        devices: list[dict] = []
+        for res, count in alloc.items():
+            if "/" not in res:
+                continue  # core resources are not devices
+            short = res.rsplit("/", 1)[1]
+            prefix = res.replace("/", "-").replace(".", "-")
+            try:
+                n = int(str(count))
+            except ValueError:
+                continue
+            for k in range(n):
+                devices.append({
+                    "name": f"{prefix}-{k}",
+                    "attributes": {
+                        "type": short,
+                        "numa": str(k * self.device_zones // n)}})
+        self._device_list = devices
+        return devices
+
     async def _publish_devices(self, node_name: str) -> None:
         """Model HOW `google.com/tpu: 8` arrives: the kubelet device
         manager / DRA driver registers the node's devices. Extended
@@ -97,30 +127,14 @@ class KwokController(Controller):
         ResourceSlice with per-device NUMA attributes, so BOTH device
         paths work against kwok nodes — legacy extended-resource counting
         (already in node.allocatable) and DRA claims."""
-        alloc = self.node_template.get("allocatable") or {}
-        devices = []
-        for res, count in alloc.items():
-            if "/" not in res:
-                continue  # core resources are not devices
-            short = res.rsplit("/", 1)[1]
-            try:
-                n = int(str(count))
-            except ValueError:
-                continue
-            for k in range(n):
-                devices.append({
-                    "name": f"{short}-{k}",
-                    "attributes": {
-                        "type": short,
-                        "numa": str(k * self.device_zones // max(1, n))}})
+        devices = self._template_devices()
         if not devices:
             return
-        from kubernetes_tpu.api.types import make_resource_slice
         try:
             await self.store.create(
                 "resourceslices",
                 make_resource_slice(node_name, self.device_driver,
-                                    devices))
+                                    [dict(d) for d in devices]))
         except AlreadyExists:
             pass
         except StoreError:
