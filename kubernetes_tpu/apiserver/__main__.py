@@ -1,0 +1,126 @@
+"""kube-apiserver analog: `python -m kubernetes_tpu.apiserver`.
+
+Serves an MVCC store over BOTH wires — HTTP/1.1+JSON (kubectl,
+controllers) and the multiplexed KTPU wire (core components) — with
+optional WAL durability (crash recovery on restart), bearer-token authn,
+and RBAC loaded from a manifest.
+
+    python -m kubernetes_tpu.apiserver --port 8080 \
+        --data-dir /var/lib/ktpu --wire-port 8081
+
+Parity target: cmd/kube-apiserver (SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="ktpu-apiserver", description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--wire-port", type=int, default=0,
+                    help="KTPU wire listener port (0 = ephemeral; "
+                         "'off' via --no-wire)")
+    ap.add_argument("--no-wire", action="store_true")
+    ap.add_argument("--data-dir", default=None,
+                    help="durability directory (WAL + snapshots); "
+                         "recovers state on startup when present")
+    ap.add_argument("--fsync", choices=["batch", "always"], default="batch")
+    ap.add_argument("--token", action="append", default=[],
+                    metavar="TOKEN=USER",
+                    help="static bearer token (repeatable)")
+    ap.add_argument("--rbac", default=None,
+                    help="YAML manifest of ClusterRole/ClusterRoleBinding "
+                         "objects enabling RBAC authz")
+    ap.add_argument("--audit-log", action="store_true")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable OTel-style request spans")
+    return ap
+
+
+async def serve(args) -> None:
+    from kubernetes_tpu.store import (
+        DurabilityManager,
+        install_core_validation,
+        new_cluster_store,
+        recover_store,
+    )
+    if args.data_dir:
+        store = recover_store(args.data_dir,
+                              factory=new_cluster_store)
+        mgr = DurabilityManager(store, args.data_dir, fsync=args.fsync)
+        mgr.start()
+    else:
+        store = new_cluster_store()
+        mgr = None
+    install_core_validation(store)
+
+    tokens = {}
+    for spec in args.token:
+        token, _, user = spec.partition("=")
+        if token and user:
+            tokens[token] = user
+
+    authorizer = None
+    if args.rbac:
+        import yaml
+
+        from kubernetes_tpu.apiserver.rbac import RBACAuthorizer
+        authorizer = RBACAuthorizer()
+        with open(args.rbac) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc:
+                    continue
+                if doc.get("kind") == "ClusterRole":
+                    authorizer.add_role(doc)
+                elif doc.get("kind") == "ClusterRoleBinding":
+                    authorizer.add_binding(doc)
+
+    if args.trace:
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        DEFAULT_TRACER.enabled = True
+
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.apiserver.wire import WireServer
+    api = APIServer(store, host=args.host, port=args.port,
+                    bearer_tokens=tokens, authorizer=authorizer,
+                    audit_log=args.audit_log)
+    await api.start()
+    wire = None
+    if not args.no_wire:
+        wire = WireServer.for_apiserver(api, host=args.host,
+                                        port=args.wire_port)
+        await wire.start()
+        logging.info("wire listening on %s", wire.target)
+    logging.info("apiserver listening on %s", api.url)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    if wire is not None:
+        await wire.stop()
+    await api.stop()
+    if mgr is not None:
+        await mgr.stop(final_snapshot=True)
+    store.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    asyncio.run(serve(args))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,124 @@
+"""kube-controller-manager analog: `python -m kubernetes_tpu.controllers`.
+
+Hosts the full controller set over one informer factory against a remote
+apiserver, with leader election.
+
+    python -m kubernetes_tpu.controllers --server http://127.0.0.1:8080 \
+        --controllers deployment,replicaset,job,cronjob,gc
+
+Parity target: cmd/kube-controller-manager (SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+#: name -> constructor(store); the default set mirrors the reference's
+#: always-on controllers.
+REGISTRY = {
+    "deployment": "DeploymentController",
+    "replicaset": "ReplicaSetController",
+    "statefulset": "StatefulSetController",
+    "daemonset": "DaemonSetController",
+    "job": "JobController",
+    "cronjob": "CronJobController",
+    "nodelifecycle": "NodeLifecycleController",
+    "podgc": "PodGCController",
+    "gc": "GarbageCollectorController",
+    "namespace": "NamespaceController",
+    "endpointslice": "EndpointSliceController",
+    "resourcequota": "ResourceQuotaController",
+    "disruption": "DisruptionController",
+    "ttl": "TTLAfterFinishedController",
+    "hpa": "HorizontalPodAutoscalerController",
+    "pvbinder": "PVBinderController",
+    "attachdetach": "AttachDetachController",
+    "resourceclaim": "ResourceClaimController",
+    "serviceaccount": "ServiceAccountController",
+    "serviceaccount-token": "TokenController",
+    "kubeproxy": "KubeProxyController",
+}
+
+DEFAULT_SET = [n for n in REGISTRY if n not in ("kubeproxy",)]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="ktpu-controller-manager",
+                                 description=__doc__)
+    ap.add_argument("--server", default=None)
+    ap.add_argument("--wire", default=None)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--controllers", default=",".join(DEFAULT_SET),
+                    help="comma list (default: all but kubeproxy)")
+    ap.add_argument("--leader-elect", action="store_true")
+    return ap
+
+
+async def serve(args) -> None:
+    if args.wire:
+        from kubernetes_tpu.apiserver.wire import WireStore
+        store = WireStore(args.wire, token=args.token,
+                          user_agent="ktpu-controller-manager")
+    elif args.server:
+        from kubernetes_tpu.apiserver.client import RemoteStore
+        store = RemoteStore(args.server, token=args.token,
+                            user_agent="ktpu-controller-manager")
+    else:
+        raise SystemExit("one of --server / --wire is required")
+
+    import kubernetes_tpu.controllers as C
+    wanted = [n.strip() for n in args.controllers.split(",") if n.strip()]
+    controllers = []
+    for name in wanted:
+        cls_name = REGISTRY.get(name)
+        if cls_name is None:
+            raise SystemExit(f"unknown controller {name!r}")
+        controllers.append(getattr(C, cls_name)(store))
+    mgr = C.ControllerManager(store, controllers)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+
+    if args.leader_elect:
+        import uuid
+
+        from kubernetes_tpu.client.leaderelection import LeaderElector
+        elector = LeaderElector(
+            store, "kube-controller-manager",
+            identity=f"ktpu-cm-{uuid.uuid4().hex[:8]}")
+
+        async def run_managed():
+            await mgr.start()
+            await stop.wait()
+
+        task = asyncio.ensure_future(elector.run(run_managed))
+    else:
+        await mgr.start()
+        task = None
+    logging.info("controller-manager running: %s", ", ".join(wanted))
+    await stop.wait()
+    await mgr.stop()
+    if task is not None:
+        task.cancel()
+    close = getattr(store, "close", None)
+    if close is not None:
+        await close()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    asyncio.run(serve(args))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -106,7 +106,9 @@ class KwokController(Controller):
             if "/" not in res:
                 continue  # core resources are not devices
             short = res.rsplit("/", 1)[1]
-            prefix = res.replace("/", "-").replace(".", "-")
+            # '/' alone is mapped (dots stay) so distinct resources can't
+            # sanitize to the same device-name prefix.
+            prefix = res.replace("/", "--")
             try:
                 n = int(str(count))
             except ValueError:
@@ -131,10 +133,11 @@ class KwokController(Controller):
         if not devices:
             return
         try:
+            # store.create deep-copies on entry; the shared list is safe.
             await self.store.create(
                 "resourceslices",
                 make_resource_slice(node_name, self.device_driver,
-                                    [dict(d) for d in devices]))
+                                    devices))
         except AlreadyExists:
             pass
         except StoreError:
