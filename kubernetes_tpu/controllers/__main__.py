@@ -94,20 +94,24 @@ async def serve(args) -> None:
         elector = LeaderElector(
             store, "kube-controller-manager",
             identity=f"ktpu-cm-{uuid.uuid4().hex[:8]}")
-
-        async def run_managed():
-            await mgr.start()
-            await stop.wait()
-
-        task = asyncio.ensure_future(elector.run(run_managed))
+        # ControllerManager owns the fencing: losing the lease STOPS
+        # every controller so the standby replica converges instead of
+        # double-reconciling.
+        task = asyncio.ensure_future(
+            mgr.run_with_leader_election(elector))
+        logging.info("controller-manager (leader-elected): %s",
+                     ", ".join(wanted))
+        stop_task = asyncio.ensure_future(stop.wait())
+        await asyncio.wait({task, stop_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        task.cancel()
+        stop_task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
     else:
         await mgr.start()
-        task = None
-    logging.info("controller-manager running: %s", ", ".join(wanted))
-    await stop.wait()
-    await mgr.stop()
-    if task is not None:
-        task.cancel()
+        logging.info("controller-manager running: %s", ", ".join(wanted))
+        await stop.wait()
+        await mgr.stop()
     close = getattr(store, "close", None)
     if close is not None:
         await close()

@@ -95,9 +95,9 @@ class KwokController(Controller):
     def _template_devices(self) -> list[dict]:
         """Device list derived from the template ONCE (50k-node runs
         register 50k slices; re-parsing per node would be 400k throwaway
-        dict builds). Names carry the FULL resource (dots/slashes → '-')
-        so two vendors' same-suffix resources can't collide in the
-        consumed-device set."""
+        dict builds). Names carry the FULL resource with '/' → '--'
+        (dots kept) so two vendors' same-suffix resources can't collide
+        in the consumed-device set."""
         if self._device_list is not None:
             return self._device_list
         alloc = self.node_template.get("allocatable") or {}

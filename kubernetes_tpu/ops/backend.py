@@ -149,8 +149,8 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                        fit_col_w, bal_col_mask, shape_u, shape_s,
                        w_fit, w_bal, w_taint, taint_filter_on,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
-                       spread_active, perms, gang_onehot, gang_required,
-                       strategy: str, use_spread: bool):
+                       sp_applies, sp_contrib, perms, gang_onehot,
+                       gang_required, strategy: str, use_spread: bool):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -206,14 +206,18 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
             static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal, strategy,
-            dom_onehot, cid_onehot, dom_counts, max_skew, spread_active)
+            dom_onehot, cid_onehot, dom_counts, max_skew,
+            sp_applies, sp_contrib)
         assign = solver.gang_filter(a0, gang_onehot, gang_required)
-        # Gang-dropped spread pods bumped the chained counts in-scan —
-        # fold them back out so later chunks see the truth.
-        dropped = (a0 >= 0) & (assign < 0) & spread_active
+        # Gang-dropped pods bumped the chained counts in-scan (for the
+        # constraints they CONTRIBUTE to) — fold them back out so later
+        # chunks see the truth.
+        dropped = (a0 >= 0) & (assign < 0)
         safe = jnp.clip(a0, 0, alloc_q.shape[0] - 1)
+        contrib_d = sp_contrib @ cid_onehot.T                   # (P, D)
         dom_counts2 = dom_counts2 - jnp.sum(
-            jnp.where(dropped[:, None], dom_onehot[safe], 0.0), axis=0)
+            jnp.where(dropped[:, None],
+                      dom_onehot[safe] * contrib_d, 0.0), axis=0)
     else:
         assign = solver.multistart_greedy_assign(
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
@@ -557,166 +561,191 @@ class TPUBackend:
                  self._put(np.zeros((1, 1), np.float32)),
                  self._put(np.zeros((1,), np.float32)),
                  self._put(np.zeros((1,), np.float32)),
-                 self._put(np.zeros((p,), np.bool_)))
+                 self._put(np.zeros((p, 1), np.float32)),
+                 self._put(np.zeros((p, 1), np.float32)))
             self._spread_dummy_cache[key] = d
         return d
+
+    @staticmethod
+    def _spread_tpl_key(cs: list, ns: str) -> str:
+        # EVERY semantic field participates: two templates differing only
+        # in minDomains/namespaceSelector must NOT collide (the eligible
+        # one would otherwise lend its scan slot to the unmodelable one,
+        # silently dropping that constraint).
+        return repr((sorted((c.get("topologyKey", ""),
+                             repr(c.get("labelSelector")),
+                             c.get("maxSkew", 1),
+                             repr(c.get("minDomains")),
+                             repr(c.get("namespaceSelector")))
+                            for c in cs), ns))
+
+    def _build_spread_table(self, ctx, snapshot, ct, compiler,
+                            plugin) -> None:
+        """Union spread table, built ONCE per assign() from ALL chunks.
+
+        Every distinct DoNotSchedule template in the batch contributes
+        its constraints to one union list C; the scan gates each pod on
+        ITS template's columns (`applies`) and counts every placed pod in
+        the constraints its labels match (`contributes`) — heterogeneous
+        batches and cross-matching non-spread pods stay on device.
+        Templates the tensors can't model (namespaceSelector, minDomains,
+        restricted node eligibility, non-self-matching selectors) are
+        marked ineligible: their PODS take host rows + stateful verify,
+        everyone else keeps the scan."""
+        from kubernetes_tpu.api.labels import from_label_selector
+        from kubernetes_tpu.ops.affinity import _seg_sum
+
+        templates: dict[str, dict] = {}
+        for chunk in ctx.chunks:
+            for pj in chunk:
+                if not pj.topology_spread_constraints:
+                    continue
+                cs = plugin._constraints_for(pj, "DoNotSchedule")
+                if not cs:
+                    continue
+                key = self._spread_tpl_key(cs, pj.namespace)
+                t = templates.get(key)
+                if t is None:
+                    t = templates[key] = {
+                        "cons": cs, "ns": pj.namespace, "pods": [],
+                        "eligible": not any(
+                            c.get("namespaceSelector")
+                            or c.get("minDomains") for c in cs),
+                        "sels": [from_label_selector(
+                            c.get("labelSelector")) for c in cs],
+                    }
+                t["pods"].append(pj)
+                if t["eligible"]:
+                    if not all(s.matches(pj.labels) for s in t["sels"]):
+                        t["eligible"] = False  # non-self-matching member
+                    elif not compiler.eligibility_row(
+                            pj)[: ct.n_real].all():
+                        t["eligible"] = False  # per-pod node eligibility
+
+        cons: list[dict] = []      # union constraint list
+        con_ns: list[str] = []
+        con_sels: list = []
+        tpl_cols: dict[str, list[int]] = {}
+        for key, t in templates.items():
+            if not t["eligible"]:
+                continue
+            cols = []
+            for cidx, c in enumerate(t["cons"]):
+                cols.append(len(cons))
+                cons.append(c)
+                con_ns.append(t["ns"])
+                con_sels.append(t["sels"][cidx])
+            tpl_cols[key] = cols
+
+        dom_slices = [compiler.topo.domains(c["topologyKey"])
+                      for c in cons]
+        D = sum(num - 1 for _, num in dom_slices)
+        if not cons or D == 0:
+            ctx.spread = {"cons": [], "tpl_cols": {},
+                          "ineligible": {k for k, t in templates.items()
+                                         if not t["eligible"]} | set(
+                                             templates)}
+            return
+
+        N = ct.n_pad
+        dom_onehot = np.zeros((N, D), dtype=np.float32)
+        cid_onehot = np.zeros((D, len(cons)), dtype=np.float32)
+        counts0 = np.zeros((D,), dtype=np.float32)
+        val_maps: list[dict] = []
+        g = 0
+        for cidx, (dom_ids, num) in enumerate(dom_slices):
+            counts = compiler.counts_for(
+                cons[cidx].get("labelSelector"), (con_ns[cidx],))
+            d = _seg_sum(np.where(dom_ids > 0, counts, 0.0), dom_ids, num)
+            vmap: dict = {}
+            tk = cons[cidx]["topologyKey"]
+            for k in range(1, num):
+                members = dom_ids == k
+                dom_onehot[members, g] = 1.0
+                cid_onehot[g, cidx] = 1.0
+                counts0[g] = d[k]
+                rep = int(np.argmax(members[: ct.n_real]))
+                vmap[snapshot.nodes[rep].labels.get(tk)] = g
+                g += 1
+            val_maps.append(vmap)
+        # The table is built in _start BEFORE any chunk dispatches, so
+        # ctx.delta is empty here by construction — every same-assign
+        # placement is counted by the scan itself (sp_contrib).
+        ctx.spread = {
+            "cons": cons, "con_ns": con_ns, "con_sels": con_sels,
+            "tpl_cols": tpl_cols,
+            "ineligible": {k for k, t in templates.items()
+                           if not t["eligible"]},
+            "dom_onehot_host": dom_onehot,
+            "cid_onehot_host": cid_onehot,
+            "val_maps": val_maps,
+            "dev_dom": self._put(dom_onehot, "nodes_mat"),
+            "dev_cid": self._put(cid_onehot),
+            "dev_skew": self._put(np.array(
+                [float(c.get("maxSkew", 1)) for c in cons], np.float32)),
+            "dev_counts": self._put(counts0),
+        }
 
     def _process_spread_pods(self, spread_pods, pods, ctx, snapshot, ct,
                              apply_row, stateful_pods, dyn_states,
                              fwk) -> list[int]:
         """Hard (DoNotSchedule) PodTopologySpread routing.
 
-        Homogeneous template — every spread pod in the batch shares ONE
-        constraint set, self-matches its selectors, all nodes are eligible,
-        and no other batch pod matches the selectors — goes to the DEVICE
-        scan (solver.greedy_assign_rescoring_spread): domain counts ride
-        the scan carry, so tight maxSkew stays sequential-exact without
-        the batch-then-verify requeue collapse. Anything else poisons the
-        template and falls back to host rows + stateful verify."""
-        from kubernetes_tpu.api.labels import from_label_selector
-        from kubernetes_tpu.ops.affinity import _seg_sum
+        Templates the union table models go to the DEVICE scan
+        (solver.greedy_assign_rescoring_spread): domain counts ride the
+        scan carry, so tight maxSkew stays sequential-exact without the
+        batch-then-verify requeue collapse — including heterogeneous
+        batches mixing several templates. Unmodelable templates' pods
+        fall back to host rows + stateful verify, counted (not silent)."""
         if not spread_pods:
             return []
         compiler = self._affinity_compiler(snapshot, ct)
         plugin = next(p for p in fwk.filter_plugins
                       if p.NAME == "PodTopologySpread")
+        sp = ctx.spread
+        if sp is None:
+            # _start builds the table eagerly whenever the batch carries
+            # spread constraints; reaching here without one means the
+            # batch mutated mid-assign — fall back rather than run the
+            # scan against counts that missed in-flight chunks.
+            logger.error("spread table missing at chunk prep; routing "
+                         "%d pods to host rows", len(spread_pods))
+            sp = {"tpl_cols": {}}
 
-        first_pi, first_cs = spread_pods[0][1], spread_pods[0][2]
-        ns = first_pi.namespace
-        tpl_key = repr((sorted((c.get("topologyKey", ""),
-                                repr(c.get("labelSelector")),
-                                c.get("maxSkew", 1)) for c in first_cs), ns))
-        eligible = (not ctx.spread_poisoned
-                    and not any(c.get("namespaceSelector")
-                                or c.get("minDomains") for c in first_cs)
-                    and (ctx.spread is None or ctx.spread["key"] == tpl_key))
-        if eligible:
-            sels = [from_label_selector(c.get("labelSelector"))
-                    for c in first_cs]
-            for i, pi, cs in spread_pods:
-                if pi.namespace != ns or repr((sorted(
-                        (c.get("topologyKey", ""),
-                         repr(c.get("labelSelector")),
-                         c.get("maxSkew", 1)) for c in cs), ns)) != tpl_key:
-                    eligible = False
-                    break
-                if not all(s.matches(pi.labels) for s in sels):
-                    eligible = False
-                    break
-                if not compiler.eligibility_row(pi)[: ct.n_real].all():
-                    eligible = False
-                    break
-            if eligible and ctx.spread is None:
-                # A selector-matching pod WITHOUT the template constraints
-                # — in ANY chunk of this assign(), not just this one —
-                # would change domain counts invisibly to the scan (chunks
-                # without spread pods never re-enter this function, and
-                # in-flight chunks can't be retro-checked). All chunks are
-                # known up front, so gate the template on the whole batch
-                # ONCE, at build time.
-                for chunk in ctx.chunks:
-                    for pj in chunk:
-                        if pj.namespace != ns or not any(
-                                s.matches(pj.labels) for s in sels):
-                            continue
-                        cs_j = plugin._constraints_for(pj, "DoNotSchedule")
-                        if repr((sorted((c.get("topologyKey", ""),
-                                         repr(c.get("labelSelector")),
-                                         c.get("maxSkew", 1))
-                                        for c in cs_j), ns)) != tpl_key:
-                            eligible = False
-                            break
-                    if not eligible:
-                        break
-
-        if eligible and ctx.spread is None:
-            # Build the template's device tensors once per assign().
-            slices = [compiler.topo.domains(c["topologyKey"])
-                      for c in first_cs]
-            D = sum(num - 1 for _, num in slices)
-            if D == 0:
-                eligible = False  # no domains at all → host path
-            else:
-                N = ct.n_pad
-                dom_onehot = np.zeros((N, D), dtype=np.float32)
-                cid_onehot = np.zeros((D, len(first_cs)), dtype=np.float32)
-                counts0 = np.zeros((D,), dtype=np.float32)
-                val_maps: list[dict] = []
-                g = 0
-                for cidx, (dom_ids, num) in enumerate(slices):
-                    counts = compiler.counts_for(
-                        first_cs[cidx].get("labelSelector"), (ns,))
-                    d = _seg_sum(np.where(dom_ids > 0, counts, 0.0),
-                                 dom_ids, num)
-                    vmap: dict = {}
-                    tk = first_cs[cidx]["topologyKey"]
-                    for k in range(1, num):
-                        members = dom_ids == k
-                        dom_onehot[members, g] = 1.0
-                        cid_onehot[g, cidx] = 1.0
-                        counts0[g] = d[k]
-                        rep = int(np.argmax(members[: ct.n_real]))
-                        vmap[snapshot.nodes[rep].labels.get(tk)] = g
-                        g += 1
-                    val_maps.append(vmap)
-                # Same-assign placements accepted before the template
-                # existed still count.
-                sels = [from_label_selector(c.get("labelSelector"))
-                        for c in first_cs]
-                for dpi, dlabels in ctx.delta:
-                    if dpi.namespace != ns:
-                        continue
-                    for cidx, c in enumerate(first_cs):
-                        if sels[cidx].matches(dpi.labels):
-                            gi = val_maps[cidx].get(
-                                dlabels.get(c["topologyKey"]))
-                            if gi is not None:
-                                counts0[gi] += 1.0
-                ctx.spread = {
-                    "key": tpl_key,
-                    "dom_onehot_host": dom_onehot,
-                    "val_maps": val_maps,
-                    "cons": first_cs, "ns": ns,
-                    "dev_dom": self._put(dom_onehot, "nodes_mat"),
-                    "dev_cid": self._put(cid_onehot),
-                    "dev_skew": self._put(np.array(
-                        [float(c.get("maxSkew", 1)) for c in first_cs],
-                        np.float32)),
-                    "dev_counts": self._put(counts0),
-                }
-
-        if eligible:
-            return [i for i, _, _ in spread_pods]
-
-        # Fallback: poison + host rows + stateful verify (the pre-template
-        # behavior). In-flight scan-trusted chunks get host re-checked at
-        # verify time via the poisoned flag. This cliff is a perf trap
-        # (one heterogeneous pod drops the whole batch's spread work to
-        # host rows) — make it observable, never silent.
-        if not ctx.spread_poisoned:
-            logger.warning(
-                "PodTopologySpread device template POISONED for this "
-                "batch (%d spread pods fall back to host rows): "
-                "heterogeneous constraints/labels or ineligible nodes",
-                len(spread_pods))
-            if self.metrics is not None:
-                self.metrics.backend_degradations.inc(
-                    kind="spread_poisoned")
-        ctx.spread_poisoned = True
+        active: list[int] = []
+        fallback: list[tuple[int, object, list]] = []
         for i, pi, cs in spread_pods:
-            if not any(c.get("namespaceSelector") for c in cs):
-                row = compiler.spread_filter_row(pi, cs)[: ct.n_real]
-                if not row.all():
-                    apply_row("PodTopologySpread", i, row)
-                stateful_pods.add(i)
+            key = self._spread_tpl_key(cs, pi.namespace)
+            if key in sp["tpl_cols"]:
+                active.append(i)
             else:
-                state = dyn_states.setdefault(i, CycleState())
-                row = self._dynamic_filter_row(
-                    plugin, pi, ctx.snapshot, ct, state)
-                if row is not None:
-                    apply_row("PodTopologySpread", i, row)
+                fallback.append((i, pi, cs))
+
+        if fallback:
+            if not ctx.spread_poisoned:
+                logger.warning(
+                    "PodTopologySpread: %d pods' templates can't ride the "
+                    "device scan (namespaceSelector/minDomains/eligibility"
+                    "/self-match) — host rows + stateful verify for them",
+                    len(fallback))
+                if self.metrics is not None:
+                    self.metrics.backend_degradations.inc(
+                        kind="spread_poisoned")
+            ctx.spread_poisoned = True
+            for i, pi, cs in fallback:
+                if not any(c.get("namespaceSelector") for c in cs):
+                    row = compiler.spread_filter_row(pi, cs)[: ct.n_real]
+                    if not row.all():
+                        apply_row("PodTopologySpread", i, row)
                     stateful_pods.add(i)
-        return []
+                else:
+                    state = dyn_states.setdefault(i, CycleState())
+                    row = self._dynamic_filter_row(
+                        plugin, pi, ctx.snapshot, ct, state)
+                    if row is not None:
+                        apply_row("PodTopologySpread", i, row)
+                        stateful_pods.add(i)
+        return active
 
     # -- DynamicResources (DRA) vectorization -------------------------------
 
@@ -964,11 +993,38 @@ class TPUBackend:
         ctx.delta_idx = _DeltaAffinityIndex(ctx.sel_cache,
                                             self._ns_resolver)
         ctx.wsnap = None
-        # Device-side PodTopologySpread template (homogeneous batches):
-        # built lazily by _process_spread_pods; poisoned = fall back to
-        # host verification for spread from then on.
+        # Device-side PodTopologySpread union table: built EAGERLY when
+        # any pod in the batch carries spread constraints, so chunks
+        # dispatched before the first spread pod still count their
+        # selector-matching placements; pods of unmodelable templates
+        # fall back to host verification (spread_poisoned observability).
         ctx.spread = None
         ctx.spread_poisoned = False
+        ctx.spread_last_gated = -1
+        ctx.chunk_seq = -1
+        if any(pj.topology_spread_constraints
+               for chunk in ctx.chunks for pj in chunk):
+            sp_plugin = next((p for p in fwk.filter_plugins
+                              if p.NAME == "PodTopologySpread"), None)
+            if sp_plugin is not None:
+                self._build_spread_table(
+                    ctx, snapshot, ct,
+                    self._affinity_compiler(snapshot, ct), sp_plugin)
+                # Last chunk with scan-GATED pods: contribute-only chunks
+                # after it can keep the multistart solver (their counts
+                # no longer influence any gating decision).
+                if ctx.spread.get("cons"):
+                    cols = ctx.spread["tpl_cols"]
+                    for k, chunk in enumerate(ctx.chunks):
+                        for pj in chunk:
+                            if not pj.topology_spread_constraints:
+                                continue
+                            cs = sp_plugin._constraints_for(
+                                pj, "DoNotSchedule")
+                            if cs and self._spread_tpl_key(
+                                    cs, pj.namespace) in cols:
+                                ctx.spread_last_gated = k
+                                break
         ctx.params = self._fwk_params(fwk, ct)
         # Fresh used-state upload (ONE packed array, ~80 KB) per call;
         # chunks chain on device from here.
@@ -1031,6 +1087,8 @@ class TPUBackend:
 
     def _prep_chunk(self, pods: list[PodInfo], ctx: "_AssignCtx") -> dict:
         ct, snapshot, fwk = ctx.ct, ctx.snapshot, ctx.fwk
+        ctx.chunk_seq += 1
+        chunk_idx = ctx.chunk_seq
         P = self.max_batch
         batch = PodBatch(pods, ct, P)
         N = ct.n_pad
@@ -1163,9 +1221,39 @@ class TPUBackend:
         spread_active_idx = self._process_spread_pods(
             spread_pods, pods, ctx, snapshot, ct, apply_row, stateful_pods,
             dyn_states, fwk)
-        spread_vec = np.zeros((P,), dtype=np.bool_)
-        for i in spread_active_idx:
-            spread_vec[i] = True
+        # Per-pod constraint matrices over the UNION spread table:
+        # applies gates the pod's own template's columns; contributes
+        # marks which constraints count the pod when placed — built for
+        # EVERY pod (non-spread pods can match a template's selector).
+        sp_applies = sp_contrib = None
+        spt = ctx.spread
+        if spt is not None and spt.get("cons"):
+            C = len(spt["cons"])
+            sp_applies = np.zeros((P, C), dtype=np.float32)
+            sp_contrib = np.zeros((P, C), dtype=np.float32)
+            active_set = set(spread_active_idx)
+            for i, pi, cs in spread_pods:
+                if i in active_set:
+                    key = self._spread_tpl_key(cs, pi.namespace)
+                    for c in spt["tpl_cols"].get(key, ()):
+                        sp_applies[i, c] = 1.0
+            memo = spt.setdefault("contrib_memo", {})
+            con_ns = spt["con_ns"]
+            con_sels = spt["con_sels"]
+            for i, pi in enumerate(pods):
+                sig = (pi.namespace,
+                       tuple(sorted(pi.labels.items())) if pi.labels
+                       else ())
+                row = memo.get(sig)
+                if row is None:
+                    row = np.fromiter(
+                        (1.0 if (pi.namespace == con_ns[c]
+                                 and con_sels[c].matches(pi.labels))
+                         else 0.0 for c in range(C)),
+                        dtype=np.float32, count=C)
+                    memo[sig] = row
+                if row.any():
+                    sp_contrib[i] = row
 
         # Host score rows: computed over each pod's *feasible* node set only
         # (PreScore/Score receive filtered nodes in the reference), then the
@@ -1410,7 +1498,9 @@ class TPUBackend:
             "dev_mask": dev_mask, "dev_scores": dev_scores,
             "host_filter_fail": host_filter_fail,
             "unknown_res": unknown_res, "stateful_pods": stateful_pods,
-            "spread_active_idx": spread_active_idx, "spread_vec": spread_vec,
+            "spread_active_idx": spread_active_idx,
+            "sp_applies": sp_applies, "sp_contrib": sp_contrib,
+            "chunk_idx": chunk_idx,
             "dev_perms": dev_perms, "gang_onehot": gang_onehot,
             "gang_required": gang_required,
         }
@@ -1436,14 +1526,24 @@ class TPUBackend:
              batch.untol_filter.astype(np.int32),
              batch.untol_prefer.astype(np.int32)], axis=1)
         sp = ctx.spread
-        use_spread = bool(sp is not None and prep["spread_active_idx"]
-                          and not ctx.spread_poisoned)
+        # The spread scan must run for any chunk whose pods contribute to
+        # the table's counts (a non-spread pod matching a template's
+        # selector still moves domain counts) — UNLESS no later chunk has
+        # gated pods, in which case the counts can't influence anything
+        # and the chunk keeps the multistart solver.
+        use_spread = bool(
+            sp is not None and sp.get("cons")
+            and prep["sp_contrib"] is not None
+            and (prep["spread_active_idx"]
+                 or (prep["sp_contrib"].any()
+                     and prep["chunk_idx"] < ctx.spread_last_gated)))
         prep["spread_used"] = use_spread
         if use_spread:
             sp_args = (sp["dev_dom"], sp["dev_cid"], sp["dev_counts"],
-                       sp["dev_skew"], self._put(prep["spread_vec"]))
+                       sp["dev_skew"], self._put(prep["sp_applies"]),
+                       self._put(prep["sp_contrib"]))
         else:
-            sp_args = self._spread_dummies(ct.n_pad, prep["spread_vec"].shape[0])
+            sp_args = self._spread_dummies(ct.n_pad, batch.req_q.shape[0])
         assign_d, used_pack2, fit0_d, taint_ok_d, dom_counts2 = \
             _mask_solve_update(
                 self._dev_static["alloc_q"], self._dev_used,
@@ -1482,8 +1582,9 @@ class TPUBackend:
         # poisoned after this chunk was dispatched (a mixed chunk appeared):
         # then they re-enter the stateful set, restoring exactness.
         stateful = run["stateful_pods"]
-        if ctx.spread_poisoned and run.get("spread_used"):
-            stateful = set(stateful) | set(run["spread_active_idx"])
+        # (Templates are fixed at table-build time from ALL chunks, so a
+        # later chunk can no longer invalidate scan-trusted placements —
+        # ineligible templates' pods were already routed stateful.)
         rejects = self._verify(pods, assign, ctx, stateful)
 
         # Fold verify rejections back into the device-chained used-state so
@@ -1501,18 +1602,23 @@ class TPUBackend:
                 used[idx, r:2 * r] -= batch.req_nz_q[i]
                 used[idx, 2 * r] -= 1
             self._dev_used = self._put(used, "nodes_mat")
-            # Spread-active rejects also fold out of the chained domain
-            # counts (adds commute, same argument as the used-state).
+            # Rejected pods that CONTRIBUTED to spread counts fold out of
+            # the chained domain counts (adds commute, same argument as
+            # the used-state) — masked per constraint the pod matches.
             sp = ctx.spread
-            if sp is not None and run.get("spread_used"):
-                active = set(run["spread_active_idx"])
+            contrib = run.get("sp_contrib")
+            if sp is not None and run.get("spread_used") \
+                    and contrib is not None:
+                cid = sp["cid_onehot_host"]
                 adj = None
                 for i, idx in rejects:
-                    if i in active:
-                        if adj is None:
-                            adj = np.zeros(
-                                sp["dom_onehot_host"].shape[1], np.float32)
-                        adj -= sp["dom_onehot_host"][idx]
+                    row = contrib[i]
+                    if not row.any():
+                        continue
+                    if adj is None:
+                        adj = np.zeros(
+                            sp["dom_onehot_host"].shape[1], np.float32)
+                    adj -= sp["dom_onehot_host"][idx] * (cid @ row)
                 if adj is not None:
                     sp["dev_counts"] = self._put(
                         np.asarray(sp["dev_counts"]) + adj)
@@ -1731,7 +1837,8 @@ class _AssignCtx:
     __slots__ = ("snapshot", "fwk", "ct", "chunks", "params",
                  "assignments", "diagnostics",
                  "working", "delta", "delta_has_terms", "sel_cache",
-                 "delta_idx", "wsnap", "spread", "spread_poisoned")
+                 "delta_idx", "wsnap", "spread", "spread_poisoned",
+                 "spread_last_gated", "chunk_seq")
 
 
 def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict,

@@ -121,26 +121,31 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
                                    fit_col_w, bal_col_mask, shape_u, shape_s,
                                    w_fit, w_bal, strategy: str,
                                    dom_onehot, cid_onehot, dom_counts,
-                                   max_skew, spread_active):
+                                   max_skew, applies, contributes):
     """greedy_assign_rescoring + PodTopologySpread hard constraints INSIDE
     the scan (sequential-equivalent, like capacity).
 
     The batch-then-verify split is pathological for tight `maxSkew`: the
     solver's batch-start masks let every pod into one domain, the host
     verify rejects all but ~(domains × maxSkew) per batch, and throughput
-    collapses to a requeue loop. For the homogeneous-template case (every
-    spread-constrained pod in the batch shares one constraint set and
-    matches its own selectors — the perf-family / gang shape), the domain
-    counts ride the scan carry instead:
+    collapses to a requeue loop. The domain counts ride the scan carry
+    instead — and the constraint set is the UNION across every spread
+    template in the batch, so heterogeneous batches (several templates,
+    plus non-spread pods matching some template's selector) stay on
+    device instead of poisoning to host rows:
 
     dom_onehot: (N, D) float32 — node → domain one-hot over the union of
-        the template's constraints' domains (eligible nodes only; a node
-        missing a constraint's topology key has no domain for it and is
-        rejected, DoNotSchedule semantics).
+        ALL constraints' domains (eligible nodes only; a node missing a
+        constraint's topology key has no domain for it and is rejected,
+        DoNotSchedule semantics).
     cid_onehot: (D, C) float32 — domain → owning constraint.
     dom_counts: (D,) float32 — batch-start matching-pod count per domain.
     max_skew:   (C,) float32 per constraint.
-    spread_active: (P,) bool — pods that participate (check + count).
+    applies:     (P, C) float32 — constraint c GATES pod p's placement
+        (p carries it in its own template).
+    contributes: (P, C) float32 — pod p COUNTS toward constraint c when
+        placed (namespace + selector match) — computed for every pod in
+        the chunk, spread-constrained or not.
 
     Returns (assign, dom_counts') so the caller can chain counts across
     chunks on device, exactly like the packed used-state.
@@ -153,20 +158,20 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
 
     def step(carry, inp):
         free_q, free_pods, used_nz, dcounts = carry
-        req, req_nz, m, sc_static, active = inp
+        req, req_nz, m, sc_static, app, contrib = inp
         # min count over each constraint's domains (empty domains included).
         min_c = jnp.min(
             jnp.where(cid_onehot > 0, dcounts[:, None], big), axis=0)  # (C,)
         allowed_d = (dcounts + 1.0 - cid_onehot @ min_c) \
             <= (cid_onehot @ max_skew)                                 # (D,)
         node_c_ok = (dom_onehot @ (allowed_d[:, None] * cid_onehot)) > 0
-        # Every constraint: the node must belong to one of its domains
-        # (has_key, DoNotSchedule rejects keyless nodes) AND that domain's
-        # skew must allow one more pod. A node has ≤1 domain per
-        # constraint, so membership-in-allowed covers both.
-        spread_ok = jnp.all(node_c_ok, axis=1)
+        # Every constraint THE POD CARRIES: the node must belong to one of
+        # its domains (has_key, DoNotSchedule rejects keyless nodes) AND
+        # that domain's skew must allow one more pod. A node has ≤1 domain
+        # per constraint, so membership-in-allowed covers both.
+        spread_ok = jnp.all(node_c_ok | (app[None, :] == 0), axis=1)
         fits = m & jnp.all(req[None, :] <= free_q, axis=1) & (free_pods >= 1)
-        fits = fits & (spread_ok | ~active)
+        fits = fits & spread_ok
         any_fit = jnp.any(fits)
         sc = sc_static
         sc = sc + w_fit * kernels.fit_score(
@@ -181,13 +186,18 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
         free_q = free_q - jnp.where(hit[:, None], req[None, :], 0)
         free_pods = free_pods - hit.astype(jnp.int32)
         used_nz = used_nz + jnp.where(hit[:, None], req_nz[None, :], 0)
+        # The placed pod counts in the domains of constraints it MATCHES
+        # (cid @ contrib masks the chosen node's domain one-hot per
+        # constraint ownership).
         dcounts = dcounts + jnp.where(
-            any_fit & active, hit.astype(jnp.float32) @ dom_onehot, 0.0)
+            any_fit,
+            (hit.astype(jnp.float32) @ dom_onehot) * (cid_onehot @ contrib),
+            0.0)
         return (free_q, free_pods, used_nz, dcounts), idx
 
     (_, _, _, dom_counts2), assign = lax.scan(
         step, (free_q, free_pods, used_nz_q, dom_counts),
-        (req_q, req_nz_q, mask, static_scores, spread_active))
+        (req_q, req_nz_q, mask, static_scores, applies, contributes))
     return assign, dom_counts2
 
 

@@ -236,20 +236,22 @@ class TestBackendDegradationMetrics:
             await factory.wait_for_sync()
             run_task = asyncio.ensure_future(sched.run(batch_size=16))
 
-            def spread_pod(name, app, skew):
+            def spread_pod(name, app, skew, extra=None):
+                c = {"maxSkew": skew,
+                     "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "DoNotSchedule",
+                     "labelSelector": {"matchLabels": {"app": app}}}
+                if extra:
+                    c.update(extra)
                 return make_pod(name, labels={"app": app},
-                                topology_spread_constraints=[{
-                                    "maxSkew": skew,
-                                    "topologyKey":
-                                        "topology.kubernetes.io/zone",
-                                    "whenUnsatisfiable": "DoNotSchedule",
-                                    "labelSelector": {
-                                        "matchLabels": {"app": app}}}])
-            # Two DIFFERENT spread templates pending together: the device
-            # template cannot stay homogeneous → poisons.
+                                topology_spread_constraints=[c])
+            # Heterogeneous templates now ride the UNION table; only a
+            # template the tensors can't model (minDomains here) falls
+            # back to host rows and fires the degradation counter.
             for i in range(4):
                 await store.create("pods", spread_pod(f"a{i}", "a", 1))
-                await store.create("pods", spread_pod(f"b{i}", "b", 2))
+                await store.create("pods", spread_pod(
+                    f"b{i}", "b", 2, extra={"minDomains": 2}))
             for _ in range(300):
                 pods = (await store.list("pods")).items
                 if sum(1 for p in pods if p["spec"].get("nodeName")) == 8:

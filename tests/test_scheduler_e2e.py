@@ -197,3 +197,70 @@ class TestE2E:
             factory.stop()
             store.stop()
         run(body())
+
+    def test_heterogeneous_spread_templates_exact_on_backend(self):
+        """Two DoNotSchedule spread templates + a cross-matching plain pod
+        in ONE batch: the union-table scan must satisfy BOTH templates'
+        skew exactly (no verify/requeue churn), counting the plain pod
+        where its labels match."""
+        async def body():
+            from kubernetes_tpu.ops import TPUBackend
+            store = await make_cluster(0)
+            for zone in ("a", "b", "c"):
+                for i in range(2):
+                    await store.create("nodes", make_node(
+                        f"z{zone}-{i}",
+                        labels={"topology.kubernetes.io/zone":
+                                f"zone-{zone}"}))
+            sched, factory = await start_scheduler(
+                store, backend=TPUBackend(max_batch=64))
+            loop = asyncio.ensure_future(sched.run(batch_size=64))
+
+            def spread(name, app, skew):
+                return make_pod(
+                    name, labels={"app": app}, requests={"cpu": "100m"},
+                    topology_spread_constraints=[{
+                        "maxSkew": skew,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": app}}}])
+            # one batch: 9 of template A (skew 1), 6 of template B
+            # (skew 2), and one PLAIN pod whose labels match template A.
+            plain = make_pod("plain-a", labels={"app": "a"},
+                             requests={"cpu": "100m"})
+            await store.create("pods", plain)
+            for i in range(9):
+                await store.create("pods", spread(f"a{i}", "a", 1))
+            for i in range(6):
+                await store.create("pods", spread(f"b{i}", "b", 2))
+            bound = await wait_bound(store, 16, timeout=30.0)
+            assert len(bound) == 16, len(bound)
+            zones = {"a": {}, "b": {}}
+            node_zone = {n["metadata"]["name"]:
+                         n["metadata"]["labels"][
+                             "topology.kubernetes.io/zone"]
+                         for n in (await store.list("nodes")).items}
+            for p in bound:
+                app = p["metadata"].get("labels", {}).get("app")
+                z = node_zone[p["spec"]["nodeName"]]
+                if app in zones:
+                    zones[app][z] = zones[app].get(z, 0) + 1
+            # template A counts the plain pod too: 10 matching pods over
+            # 3 zones with maxSkew 1 → per-zone counts within 1 of each
+            # other; template B within 2.
+            a_counts = [zones["a"].get(f"zone-{z}", 0)
+                        for z in ("a", "b", "c")]
+            b_counts = [zones["b"].get(f"zone-{z}", 0)
+                        for z in ("a", "b", "c")]
+            assert sum(a_counts) == 10 and sum(b_counts) == 6
+            assert max(a_counts) - min(a_counts) <= 1, a_counts
+            assert max(b_counts) - min(b_counts) <= 2, b_counts
+            # zero requeue churn: nothing was ever unschedulable
+            unsched = sched.metrics.schedule_attempts.value(
+                result="unschedulable", profile="default-scheduler")
+            assert unsched == 0, unsched
+            await sched.stop()
+            loop.cancel()
+            factory.stop()
+            store.stop()
+        run(body())
