@@ -47,10 +47,14 @@ def main(argv=None) -> int:
                     help="backend solve chunk (jit batch signature); "
                          "smaller chunks pipeline better against binding "
                          "traffic now that assignments stream per chunk")
-    ap.add_argument("--through-apiserver", action="store_true",
+    ap.add_argument("--through-apiserver", action=argparse.BooleanOptionalAction,
+                    default=True,
                     help="cross the process boundary: workload writes, "
                          "informers, and binding POSTs go over the "
-                         "apiserver (reference scheduler_perf topology)")
+                         "apiserver (reference scheduler_perf topology). "
+                         "DEFAULT ON so the headline measures the honest "
+                         "boundary; --no-through-apiserver for the "
+                         "in-process store topology")
     ap.add_argument("--transport", choices=["wire", "http"], default="wire",
                     help="apiserver transport for --through-apiserver: "
                          "'wire' = the multiplexed framed wire core "

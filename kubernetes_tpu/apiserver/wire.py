@@ -17,7 +17,13 @@ priority-and-fairness seats → audit → RBAC authz → admission webhooks →
 store. A WireServer shares the APIServer's PriorityLevels, tokens,
 authorizer and admission objects, so policy is identical on both wires.
 
-Frame format: 4-byte big-endian length + JSON body.
+Frame format: 4-byte big-endian length + body. The body is msgpack (the
+protobuf-role binary codec: ~3x faster to encode/decode than JSON on
+this host and ~25% smaller on the socket) or JSON — codecs are
+self-distinguishing (msgpack arrays start 0x9x/0xdc/0xdd, JSON arrays
+with '['), so each side decodes per frame and replies in the codec the
+peer last spoke. Core components use msgpack; JSON remains for
+debugging and hand-rolled clients.
   client→server: [id, op, ...args]
     ["", "hello", {"token": t, "ua": ...}]     (id "" = pre-auth)
     [id, "create", resource, obj]
@@ -45,6 +51,8 @@ import json
 import logging
 import struct
 from typing import AsyncIterator, Callable, Mapping
+
+import msgpack
 
 from kubernetes_tpu.api.labels import (
     Selector,
@@ -82,6 +90,23 @@ _VERB_OF = {"create": "create", "get": "get", "update": "update",
             "watch": "watch", "kinds": "get", "apply": "patch"}
 
 _dumps = json.dumps
+_packb = msgpack.packb
+_unpackb = msgpack.unpackb
+
+
+def _decode_frame(payload: bytes):
+    """Decode one frame body, either codec. Returns (frame, is_msgpack)."""
+    lead = payload[0]
+    if lead == 0x5B or lead in (0x20, 0x09, 0x0A, 0x0D):  # '[' / ws → JSON
+        return json.loads(payload), False
+    return _unpackb(payload), True
+
+
+def _encode_reply(frame: list, mp: bool) -> bytes:
+    """Encode a server reply in the codec the peer speaks (the server-side
+    dual of WireStore._encode)."""
+    return _packb(frame) if mp else \
+        _dumps(frame, separators=(",", ":")).encode()
 
 
 def _reason_for(exc: StoreError) -> str:
@@ -106,6 +131,19 @@ def encode_event_object(ev: Event) -> bytes:
     return b
 
 
+def encode_event_object_mp(ev: Event) -> bytes:
+    """msgpack twin of encode_event_object — one packing per event
+    shared across every msgpack watcher."""
+    b = getattr(ev, "_wire_obj_mp", None)
+    if b is None:
+        b = _packb(ev.object)
+        try:
+            ev._wire_obj_mp = b
+        except AttributeError:
+            pass
+    return b
+
+
 class _Conn(asyncio.Protocol):
     """One client connection on the server side."""
 
@@ -115,6 +153,9 @@ class _Conn(asyncio.Protocol):
         self.buf = bytearray()
         self.user = "system:anonymous"
         self.flow = "wire"
+        #: codec the peer speaks (learned per received frame; replies and
+        #: watch pushes mirror it).
+        self._mp = False
         #: watch id -> pump task
         self.watches: dict[str, asyncio.Task] = {}
         self._out: list[bytes] = []
@@ -161,8 +202,8 @@ class _Conn(asyncio.Protocol):
             payload = bytes(self.buf[4:4 + n])
             del self.buf[:4 + n]
             try:
-                frame = json.loads(payload)
-            except json.JSONDecodeError:
+                frame, self._mp = _decode_frame(payload)
+            except Exception:
                 logger.error("wire: undecodable frame; closing")
                 self.transport.close()
                 return
@@ -186,12 +227,10 @@ class _Conn(asyncio.Protocol):
             self._out.clear()
 
     def _ok(self, rid: str, result) -> None:
-        self.send(_dumps([rid, "ok", result],
-                         separators=(",", ":")).encode())
+        self.send(_encode_reply([rid, "ok", result], self._mp))
 
     def _err(self, rid: str, reason: str, message: str) -> None:
-        self.send(_dumps([rid, "err", reason, message],
-                         separators=(",", ":")).encode())
+        self.send(_encode_reply([rid, "err", reason, message], self._mp))
 
     # -- handler chain (server.py middleware order) ------------------------
 
@@ -404,21 +443,33 @@ class _Conn(asyncio.Protocol):
                 resource, resource_version=int(args.get("rv") or 0),
                 namespace=args.get("namespace"), selector=sel)
         except Expired as e:
-            self.send(_dumps([wid, "exp", str(e)],
-                             separators=(",", ":")).encode())
+            self.send(_encode_reply([wid, "exp", str(e)], self._mp))
             return
         task = asyncio.ensure_future(self._watch_pump(wid, watch))
         self.watches[wid] = task
         task.add_done_callback(lambda _t: self.watches.pop(wid, None))
 
     async def _watch_pump(self, wid: str, watch) -> None:
-        wid_b = _dumps(wid).encode()
+        # Codec is fixed per connection by the time a watch starts (the
+        # client spoke at least the hello + watch frames already).
+        mp = self._mp
+        wid_b = _packb(wid) if mp else _dumps(wid).encode()
         try:
             async for ev in watch:
                 if ev.type == "BOOKMARK":
-                    body = (b'[' + wid_b + b',"ev","BOOKMARK",'
-                            b'{"metadata":{"resourceVersion":"'
-                            + str(ev.rv).encode() + b'"}}]')
+                    bm = {"metadata": {"resourceVersion": str(ev.rv)}}
+                    body = (b"\x94" + wid_b + b"\xa2ev\xa8BOOKMARK"
+                            + _packb(bm)) if mp else (
+                        b'[' + wid_b + b',"ev","BOOKMARK",'
+                        b'{"metadata":{"resourceVersion":"'
+                        + str(ev.rv).encode() + b'"}}]')
+                elif mp:
+                    # Spliced msgpack frame [wid,"ev",TYPE,obj]: fixarray(4)
+                    # header + concatenated elements — msgpack concatenates
+                    # like JSON splices, and the object bytes are packed
+                    # once per event across ALL watchers (the _mp memo).
+                    body = (b"\x94" + wid_b + b"\xa2ev"
+                            + _packb(ev.type) + encode_event_object_mp(ev))
                 else:
                     # Spliced frame: the object bytes are encoded once per
                     # event across ALL watchers (encode_event_object memo).
@@ -437,8 +488,7 @@ class _Conn(asyncio.Protocol):
             raise
         except Exception as e:
             logger.exception("wire: watch pump %s died", wid)
-            self.send(_dumps([wid, "exp", f"watch error: {e}"],
-                             separators=(",", ":")).encode())
+            self.send(_encode_reply([wid, "exp", f"watch error: {e}"], mp))
         finally:
             aclose = getattr(watch, "aclose", None)
             if aclose is not None:
@@ -569,11 +619,20 @@ class _ClientProto(asyncio.Protocol):
                 return
             payload = bytes(self.buf[4:4 + n])
             del self.buf[:4 + n]
-            self.owner._on_frame(json.loads(payload))
+            self.owner._on_frame(_decode_frame(payload)[0])
 
 
 class _WireWatch:
-    """Client side of one pushed watch stream."""
+    """Client side of one pushed watch stream.
+
+    The queue is BOUNDED (advisor r4): the client reads the socket
+    eagerly, so the server's pause_writing backpressure cannot protect a
+    consumer that stops iterating — without a bound, events would pile
+    up in this queue without limit. On overflow the watch terminates
+    with the Expired signal, the same contract as the store channel's
+    bounded window: the consumer relists and re-watches."""
+
+    MAX_BUFFERED = 8192
 
     def __init__(self, wid: str):
         self.wid = wid
@@ -588,7 +647,8 @@ class WireStore:
     same loop tick coalesce into one socket write."""
 
     def __init__(self, target: str, *, token: str | None = None,
-                 user_agent: str = "kubernetes-tpu-wire"):
+                 user_agent: str = "kubernetes-tpu-wire",
+                 enc: str = "msgpack"):
         if target.startswith("unix:"):
             self.path: str | None = target[len("unix:"):]
             self.host, self.port = "", 0
@@ -598,6 +658,10 @@ class WireStore:
             self.host, self.port = host or "127.0.0.1", int(port)
         self.token = token
         self.user_agent = user_agent
+        #: frame codec: "msgpack" (default — the binary fast path) or
+        #: "json"; the server mirrors whichever the client speaks.
+        self._encode = (_packb if enc == "msgpack" else
+                        lambda f: _dumps(f, separators=(",", ":")).encode())
         self._proto: _ClientProto | None = None
         self._next_id = 0
         self._pending: dict[str, asyncio.Future] = {}
@@ -686,7 +750,7 @@ class WireStore:
     # -- framing -----------------------------------------------------------
 
     def _send(self, frame: list) -> None:
-        body = _dumps(frame, separators=(",", ":")).encode()
+        body = self._encode(frame)
         self._out.append(_LEN.pack(len(body)))
         self._out.append(body)
         if not self._flush_scheduled:
@@ -704,16 +768,14 @@ class WireStore:
         ops, self._tick_ops = self._tick_ops, []
         if len(ops) == 1:
             rid, op_frame = ops[0]
-            body = _dumps([rid, *op_frame],
-                          separators=(",", ":")).encode()
+            body = self._encode([rid, *op_frame])
             self._out.append(_LEN.pack(len(body)))
             self._out.append(body)
         elif ops:
             self._next_id += 1
             mid = f"m{self._next_id}"
             self._multis[mid] = [rid for rid, _ in ops]
-            body = _dumps([mid, "multi", [f for _, f in ops]],
-                          separators=(",", ":")).encode()
+            body = self._encode([mid, "multi", [f for _, f in ops]])
             self._out.append(_LEN.pack(len(body)))
             self._out.append(body)
         if self._out and self._proto is not None \
@@ -744,7 +806,17 @@ class WireStore:
         if kind == "ev":
             w = self._watches.get(rid)
             if w is not None and not w.closed:
-                w.queue.put_nowait(("ev", frame[2], frame[3]))
+                if w.queue.qsize() >= w.MAX_BUFFERED:
+                    # Consumer stopped draining: expire the watch instead
+                    # of buffering without bound (see _WireWatch).
+                    self._watches.pop(rid, None)
+                    w.closed = True
+                    w.queue.put_nowait(
+                        ("exp", "watch expired: client buffer overflow "
+                                "(consumer too slow)"))
+                    self._send([rid, "stopwatch"])
+                else:
+                    w.queue.put_nowait(("ev", frame[2], frame[3]))
             return
         if kind == "exp":
             w = self._watches.pop(rid, None)

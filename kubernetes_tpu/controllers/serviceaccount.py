@@ -18,6 +18,7 @@ both wires with no static bearer_tokens entry.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import secrets as _secrets
 
@@ -131,24 +132,51 @@ class TokenController(Controller):
         if existing is not None:
             return
         token = f"sa-{_secrets.token_urlsafe(24)}"
-        secret = new_object(
-            "Secret", f"{sa_name}-token", ns,
-            type=SA_TOKEN_TYPE,
-            data={"token": token, "namespace": ns})
-        secret["metadata"]["annotations"] = {SA_NAME_ANN: sa_name}
-        secret["metadata"]["ownerReferences"] = [{
-            "apiVersion": "v1", "kind": "ServiceAccount",
-            "name": sa_name, "uid": sa.get("metadata", {}).get("uid", ""),
-            "controller": True}]
-        try:
-            await self.store.create("secrets", secret, return_copy=False)
-        except AlreadyExists:
-            pass
+        secret_name = None
+        # The fallback suffix is DETERMINISTIC (derived from the SA uid):
+        # informer-lag resyncs recompute the same name and collide on
+        # AlreadyExists instead of minting a new secret per sync.
+        uid = (sa.get("metadata") or {}).get("uid") or ""
+        suffix = (uid.replace("-", "")[:6]
+                  or hashlib.sha256(key.encode()).hexdigest()[:6])
+        for candidate in (f"{sa_name}-token",
+                          f"{sa_name}-token-{suffix}"):
+            secret = new_object(
+                "Secret", candidate, ns,
+                type=SA_TOKEN_TYPE,
+                data={"token": token, "namespace": ns})
+            secret["metadata"]["annotations"] = {SA_NAME_ANN: sa_name}
+            secret["metadata"]["ownerReferences"] = [{
+                "apiVersion": "v1", "kind": "ServiceAccount",
+                "name": sa_name,
+                "uid": sa.get("metadata", {}).get("uid", ""),
+                "controller": True}]
+            try:
+                await self.store.create("secrets", secret, return_copy=False)
+                secret_name = candidate
+                break
+            except AlreadyExists:
+                # The name may be squatted by a FOREIGN secret (wrong
+                # type/annotation) that will never authenticate; only
+                # accept it as "established" if it really is our token,
+                # else retry under a suffixed name rather than mirroring
+                # a dead name into sa.secrets.
+                try:
+                    held = await self.store.get("secrets", f"{ns}/{candidate}")
+                except StoreError:
+                    continue
+                ann = (held.get("metadata") or {}).get("annotations") or {}
+                if (held.get("type") == SA_TOKEN_TYPE
+                        and ann.get(SA_NAME_ANN) == sa_name):
+                    secret_name = candidate
+                    break
+        if secret_name is None:
+            return
 
         # Mirror the secret name into the SA (kubectl describe parity).
         def note(obj):
             secrets_list = obj.setdefault("secrets", [])
-            entry = {"name": f"{sa_name}-token"}
+            entry = {"name": secret_name}
             if entry in secrets_list:
                 return None
             secrets_list.append(entry)

@@ -22,6 +22,7 @@ atomic` lists; granular keyed-list merging is not modeled.
 
 from __future__ import annotations
 
+import copy
 from typing import Iterable, Mapping
 
 from kubernetes_tpu.store.mvcc import Conflict, NotFound
@@ -80,6 +81,12 @@ def paths_from_fields_v1(doc: Mapping, prefix: tuple = ()) -> set[tuple]:
         else:
             out.add(path)
     return out
+
+
+def _related(p: tuple, q: tuple) -> bool:
+    """True when one path is a (non-strict) prefix of the other."""
+    n = len(p) if len(p) < len(q) else len(q)
+    return p[:n] == q[:n]
 
 
 def get_path(obj: Mapping, path: tuple):
@@ -148,7 +155,10 @@ async def server_side_apply(store, resource: str, obj: Mapping, *,
         try:
             current = await store.get(resource, key)
         except NotFound:
-            fresh = dict(applied)
+            # Deep copy HERE (create path only): managedFields is injected
+            # into metadata and must not mutate the caller's input; the
+            # update path never writes into `applied`.
+            fresh = copy.deepcopy(applied)
             meta = fresh.setdefault("metadata", {})
             meta["managedFields"] = [{
                 "manager": field_manager, "operation": "Apply",
@@ -160,16 +170,27 @@ async def server_side_apply(store, resource: str, obj: Mapping, *,
 
         want_rv = current["metadata"]["resourceVersion"]
         owners = _owners(current)
+        # An applied path collides with an owned path when one is a
+        # prefix of the other, not only on exact match: applying a
+        # scalar where another manager owns deeper leaves (or a subtree
+        # under another manager's leaf) is a structural overwrite that
+        # structured-merge-diff flags (advisor r4). Value-equal exact
+        # overlaps co-own, as before.
         conflicts: list[tuple[tuple, str]] = []
+        force_strip: dict[str, set[tuple]] = {}
         for path in applied_paths:
             new_val = get_path(applied, path)
+            if get_path(current, path) == new_val:
+                continue  # no change at this leaf → no conflict
             for mgr, owned in owners.items():
-                if mgr == field_manager or path not in owned:
+                if mgr == field_manager:
                     continue
-                if get_path(current, path) != new_val:
+                overlap = {q for q in owned if _related(path, q)}
+                if overlap:
                     conflicts.append((path, mgr))
+                    force_strip.setdefault(mgr, set()).update(overlap)
         if conflicts and not force:
-            raise ApplyConflict(sorted(conflicts))
+            raise ApplyConflict(sorted(set(conflicts)))
 
         prev_own = owners.get(field_manager, set())
         removed = {
@@ -190,7 +211,7 @@ async def server_side_apply(store, resource: str, obj: Mapping, *,
                 continue
             keep = set(owned)
             if force:
-                keep -= {p for p, loser in conflicts if loser == mgr}
+                keep -= force_strip.get(mgr, set())
             keep -= removed
             if keep:
                 new_owners[mgr] = keep

@@ -187,3 +187,68 @@ class TestApplyConflictType(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestApplyStructuralConflicts(unittest.TestCase):
+    """Prefix/extension path overlaps are conflicts (structured-merge-diff
+    flags structural overwrites, not just exact-leaf collisions)."""
+
+    def test_scalar_over_foreign_subtree_conflicts(self):
+        async def body():
+            store = new_cluster_store()
+            try:
+                await store.apply(
+                    "deployments",
+                    deployment(strategy={"rollingUpdate": {"maxSurge": 2}}),
+                    field_manager="alice")
+                # bob applies spec.strategy as a SCALAR — structurally
+                # overwrites alice's deeper leaf → conflict, not silent win.
+                with self.assertRaises(ApplyConflict):
+                    await store.apply(
+                        "deployments", deployment(strategy="Recreate"),
+                        field_manager="bob")
+                # force transfers: alice loses the overlapped deep path.
+                out = await store.apply(
+                    "deployments", deployment(strategy="Recreate"),
+                    field_manager="bob", force=True)
+                self.assertEqual(out["spec"]["strategy"], "Recreate")
+                mf = {e["manager"]: e for e in
+                      out["metadata"]["managedFields"]}
+                self.assertNotIn(
+                    "f:strategy", mf.get("alice", {}).get(
+                        "fieldsV1", {}).get("f:spec", {}))
+            finally:
+                store.stop()
+        run(body())
+
+    def test_deeper_path_under_foreign_leaf_conflicts(self):
+        async def body():
+            store = new_cluster_store()
+            try:
+                await store.apply(
+                    "deployments", deployment(strategy="Recreate"),
+                    field_manager="alice")
+                with self.assertRaises(ApplyConflict):
+                    await store.apply(
+                        "deployments",
+                        deployment(strategy={"rollingUpdate":
+                                             {"maxSurge": 2}}),
+                        field_manager="bob")
+            finally:
+                store.stop()
+        run(body())
+
+    def test_apply_does_not_mutate_caller_input(self):
+        async def body():
+            store = new_cluster_store()
+            try:
+                obj = deployment()
+                before = {"apiVersion": obj["apiVersion"],
+                          "metadata": dict(obj["metadata"])}
+                await store.apply("deployments", obj,
+                                  field_manager="alice")
+                self.assertNotIn("managedFields", obj["metadata"])
+                self.assertEqual(obj["metadata"], before["metadata"])
+            finally:
+                store.stop()
+        run(body())

@@ -317,3 +317,49 @@ class TestAttachDetach(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestTokenSquattedName(unittest.TestCase):
+    """A foreign secret squatting `<sa>-token` must not wedge the token
+    controller: it falls back to a suffixed name and only mirrors names
+    that actually authenticate (advisor r4)."""
+
+    def test_foreign_secret_squat_falls_back_to_suffixed_name(self):
+        async def body():
+            async with ControllerHarness(
+                    [ServiceAccountController, TokenController]) as h:
+                squat = new_object("Secret", "robot-token", "default",
+                                   type="Opaque", data={"x": "y"})
+                await h.store.create("secrets", squat)
+                await h.store.create(
+                    "serviceaccounts",
+                    new_object("ServiceAccount", "robot", "default"))
+
+                async def sa_has_live_token():
+                    sa = await h.store.get(
+                        "serviceaccounts", "default/robot")
+                    for ref in sa.get("secrets") or []:
+                        try:
+                            s = await h.store.get(
+                                "secrets", f"default/{ref['name']}")
+                        except StoreError:
+                            continue
+                        ann = (s.get("metadata") or {}).get(
+                            "annotations") or {}
+                        if (s.get("type") ==
+                                "kubernetes.io/service-account-token"
+                                and ann.get(
+                                    "kubernetes.io/service-account.name")
+                                == "robot"):
+                            return s
+                    return None
+                tok = await h.wait_for(sa_has_live_token,
+                                       msg="suffixed token secret")
+                self.assertNotEqual(tok["metadata"]["name"], "robot-token")
+                self.assertTrue(
+                    tok["metadata"]["name"].startswith("robot-token-"))
+                # The squatter is untouched.
+                squatted = await h.store.get("secrets",
+                                             "default/robot-token")
+                self.assertEqual(squatted.get("type"), "Opaque")
+        run(body())

@@ -290,3 +290,56 @@ class TestWireUnixSocket(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestWireCodec(unittest.TestCase):
+    """msgpack is the default frame codec; JSON remains interoperable on
+    the same server, detected per frame (wire.py `_decode_frame`)."""
+
+    def test_json_client_interops_with_msgpack_default_server(self):
+        async def body():
+            async with WireHarness() as h:
+                jc = WireStore(h.server.target, enc="json")
+                try:
+                    created = await jc.create("pods", make_pod("j"))
+                    self.assertEqual(created["metadata"]["name"], "j")
+                    # msgpack client sees the same object.
+                    got = await h.client.get("pods", "default/j")
+                    self.assertEqual(got["metadata"]["uid"],
+                                     created["metadata"]["uid"])
+                finally:
+                    await jc.close()
+        run(body())
+
+    def test_msgpack_watch_push_and_bookmarkless_resume(self):
+        async def body():
+            async with WireHarness() as h:
+                c = h.client
+                w = await c.watch("pods", resource_version=0)
+                await c.create("pods", make_pod("m1"))
+                ev = await asyncio.wait_for(w.__anext__(), 5)
+                self.assertEqual(ev.type, "ADDED")
+                self.assertEqual(ev.object["metadata"]["name"], "m1")
+                await w.aclose()
+        run(body())
+
+    def test_client_watch_queue_bounded_expires_slow_consumer(self):
+        async def body():
+            async with WireHarness() as h:
+                c = h.client
+                w = await c.watch("pods", resource_version=0)
+                # Find the client-side watch record and shrink its bound
+                # so the overflow path triggers without 8k writes.
+                wid, rec = next(iter(c._watches.items()))
+                rec.MAX_BUFFERED = 4
+                for i in range(8):
+                    await c.create("pods", make_pod(f"ov-{i}"))
+                await asyncio.sleep(0.05)  # let pushes land unconsumed
+                # Consumer resumes: sees a few events then the Expired
+                # overflow signal; the watch is deregistered client-side.
+                with self.assertRaises(Exception) as ctx:
+                    for _ in range(10):
+                        await asyncio.wait_for(w.__anext__(), 5)
+                self.assertIn("overflow", str(ctx.exception))
+                self.assertNotIn(wid, c._watches)
+        run(body())
