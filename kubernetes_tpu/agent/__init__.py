@@ -1,0 +1,7 @@
+"""Hollow-kubelet node agent (SURVEY §2.5): per-node sync loop, pod
+workers, device Allocate with a local checkpoint, heartbeats."""
+
+from kubernetes_tpu.agent.agent import NodeAgent
+from kubernetes_tpu.agent.ledger import DeviceLedger
+
+__all__ = ["NodeAgent", "DeviceLedger"]

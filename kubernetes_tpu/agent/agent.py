@@ -1,0 +1,355 @@
+"""Hollow-kubelet node agent: a per-node process with a real sync loop.
+
+Parity target (SURVEY §2.5): pkg/kubelet/kubelet.go `syncLoop` (watch →
+per-pod work), pod_workers.go (serialized per-pod workers, latest update
+wins), cm/devicemanager (Allocate against the node's device inventory,
+checkpointed locally — agent/ledger.py), nodestatus/lease heartbeats,
+and kubemark's hollow kubelet (no container runtime: "running" a pod is
+a status transition, same as KWOK staging).
+
+TPU-first shape: the agent is a WATCH CONSUMER of the apiserver wire,
+filtered server-side to `spec.nodeName=<me>` (the kubelet's field
+selector — store/mvcc.py tracked fields), so N agents cost the control
+plane one filtered watch each instead of N full pod streams. Device
+allocation consumes the DRA claim status the scheduler persisted at
+PreBind (plugins/dynamicresources.py `pre_bind`): the agent performs the
+kubelet-side Allocate — claim devices -> local ledger -> checkpoint —
+and releases on termination.
+
+Run as a process:  python -m kubernetes_tpu.agent --node n0 \
+    --server unix:/tmp/ktpu.sock --checkpoint-dir /var/lib/ktpu-agent
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from kubernetes_tpu.api.meta import (
+    name_of,
+    namespace_of,
+    namespaced_name,
+    new_object,
+)
+from kubernetes_tpu.api.types import (
+    make_node,
+    make_resource_slice,
+    template_devices,
+)
+from kubernetes_tpu.agent.ledger import DeviceLedger
+from kubernetes_tpu.store.mvcc import (
+    AlreadyExists,
+    Expired,
+    NotFound,
+    StoreError,
+)
+
+logger = logging.getLogger(__name__)
+
+COMPLETE_AFTER_ANN = "kwok.x-k8s.io/complete-after"
+AGENT_ANN = "ktpu.io/agent"
+
+
+class NodeAgent:
+    """One node's agent: registers the Node, heartbeats its Lease, syncs
+    the pods bound to it, allocates claim devices, checkpoints."""
+
+    def __init__(self, store, node_name: str, *,
+                 checkpoint_dir: str = ".",
+                 node_template: dict | None = None,
+                 register: bool = True,
+                 lease_period: float = 2.0,
+                 device_driver: str = "dra.ktpu",
+                 device_zones: int = 2):
+        self.store = store
+        self.node_name = node_name
+        self.node_template = node_template or {}
+        self.register = register
+        self.lease_period = lease_period
+        self.device_driver = device_driver
+        self.device_zones = max(1, device_zones)
+        self.ledger = DeviceLedger(
+            os.path.join(checkpoint_dir,
+                         f"devices-{node_name}.checkpoint.json"),
+            node_name)
+        self._tasks: list[asyncio.Task] = []
+        self._workers: set[asyncio.Task] = set()
+        #: pod key -> latest observed object (None = deleted); per-pod
+        #: workers drain this map serially per key, latest state wins
+        #: (pod_workers.go UpdatePod semantics).
+        self._latest: dict[str, dict | None] = {}
+        self._active: set[str] = set()
+        #: pod keys with a staged-completion timer armed (restart-safe:
+        #: _sync_pod re-arms for Running pods found after a relist).
+        self._armed: set[str] = set()
+        self._stopped = False
+        self._ip_seq = 0
+        self._ip_base = (sum(node_name.encode()) % 200) + 16
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self.ledger.load()
+        if self.register:
+            await self._register_node()
+        # Startup reconcile (syncLoop HandlePodCleanups): restore the
+        # checkpoint against the live bound-pod set, then prime workers.
+        lst = await self.store.list(
+            "pods", fields={"spec.nodeName": self.node_name})
+        live = {namespaced_name(p) for p in lst.items}
+        dropped = self.ledger.reconcile(live)
+        if dropped:
+            logger.info("agent %s: reclaimed devices of %d departed pods",
+                        self.node_name, len(dropped))
+        for p in lst.items:
+            self._observe(namespaced_name(p), p)
+        self._tasks.append(asyncio.ensure_future(
+            self._watch_loop(lst.resource_version)))
+        self._tasks.append(asyncio.ensure_future(self._lease_loop()))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in [*self._tasks, *self._workers]:
+            t.cancel()
+        for t in [*self._tasks, *self._workers]:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        self._workers.clear()
+
+    async def _register_node(self) -> None:
+        node = make_node(self.node_name, **self.node_template)
+        node["metadata"].setdefault("annotations", {})[AGENT_ANN] = "true"
+        try:
+            await self.store.create("nodes", node)
+        except AlreadyExists:
+            pass  # restart: the Node object survives us
+        await self._publish_devices()
+
+    async def _publish_devices(self) -> None:
+        """Device-plugin registration (devicemanager ListAndWatch analog):
+        extended resources publish as one ResourceSlice with NUMA-zoned
+        device blocks — naming/zoning via api.types.template_devices, the
+        convention shared with kwok nodes."""
+        devices = template_devices(self.node_template.get("allocatable"),
+                                   self.device_zones)
+        if not devices:
+            return
+        try:
+            await self.store.create(
+                "resourceslices",
+                make_resource_slice(self.node_name, self.device_driver,
+                                    devices))
+        except AlreadyExists:
+            pass
+        except StoreError:
+            logger.exception("agent %s: device publish failed",
+                             self.node_name)
+
+    # -- watch loop (syncLoop's config source) -----------------------------
+
+    async def _watch_loop(self, from_rv: int) -> None:
+        """The kubelet's apiserver config source: a field-filtered watch;
+        on expiry/disconnect, relist and resume (reflector contract)."""
+        rv = from_rv
+        fields = {"spec.nodeName": self.node_name}
+        while not self._stopped:
+            try:
+                watch = await self.store.watch(
+                    "pods", resource_version=rv, fields=fields)
+                async for ev in watch:
+                    if ev.type == "BOOKMARK":
+                        rv = ev.rv
+                        continue
+                    rv = max(rv, ev.rv)
+                    key = namespaced_name(ev.object)
+                    self._observe(
+                        key, None if ev.type == "DELETED" else ev.object)
+            except asyncio.CancelledError:
+                raise
+            except (Expired, StoreError):
+                if self._stopped:
+                    return
+                try:
+                    lst = await self.store.list("pods", fields=fields)
+                except Exception:
+                    await asyncio.sleep(0.5)
+                    continue
+                rv = lst.resource_version
+                seen = set()
+                for p in lst.items:
+                    key = namespaced_name(p)
+                    seen.add(key)
+                    self._observe(key, p)
+                # Pods that vanished while the watch was down.
+                for key in self.ledger.reconcile(seen):
+                    self._observe(key, None)
+            except Exception:
+                logger.exception("agent %s: watch loop error",
+                                 self.node_name)
+                await asyncio.sleep(0.5)
+
+    # -- pod workers -------------------------------------------------------
+
+    def _observe(self, key: str, obj: dict | None) -> None:
+        self._latest[key] = obj
+        if key in self._active or self._stopped:
+            return
+        self._active.add(key)
+        t = asyncio.ensure_future(self._worker(key))
+        self._workers.add(t)
+        t.add_done_callback(self._workers.discard)
+
+    async def _worker(self, key: str) -> None:
+        """Serialized per-pod worker: processes the LATEST observed state
+        until none is pending, then exits (a new event respawns it)."""
+        try:
+            while key in self._latest:
+                obj = self._latest.pop(key)
+                try:
+                    await self._sync_pod(key, obj)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("agent %s: sync %s failed",
+                                     self.node_name, key)
+        finally:
+            self._active.discard(key)
+
+    async def _sync_pod(self, key: str, pod: dict | None) -> None:
+        if pod is None:
+            released = self.ledger.release(key)
+            if released:
+                logger.debug("agent %s: released %s from %s",
+                             self.node_name, released, key)
+            return
+        phase = (pod.get("status") or {}).get("phase")
+        if phase in ("Succeeded", "Failed"):
+            # Terminal: the kubelet reclaims devices at termination
+            # (devicemanager podDevices cleanup), before deletion.
+            self.ledger.release(key)
+            return
+        if phase != "Pending":
+            if phase == "Running":
+                # Restart recovery: a pod marked Running by a PREVIOUS
+                # agent incarnation still owes its staged completion —
+                # re-arm with the full delay (conservative; the original
+                # start time did not survive the process).
+                ann = (pod.get("metadata", {}).get("annotations")
+                       or {}).get(COMPLETE_AFTER_ANN)
+                if ann is not None and key not in self._armed:
+                    self._arm_completion(key, ann)
+            return
+        if not await self._allocate_devices(key, pod):
+            return  # claim not ready yet; the claim update re-syncs us
+        await self._mark_running(key, pod)
+
+    async def _allocate_devices(self, key: str, pod: dict) -> bool:
+        """Kubelet-side DRA Allocate: record the scheduler's persisted
+        per-claim device allocation in the local ledger."""
+        ns = namespace_of(pod) or "default"
+        for ref in (pod.get("spec") or {}).get("resourceClaims") or []:
+            claim_name = ref.get("resourceClaimName")
+            if not claim_name:
+                continue
+            try:
+                claim = await self.store.get(
+                    "resourceclaims", f"{ns}/{claim_name}")
+            except NotFound:
+                logger.warning("agent %s: pod %s references missing claim "
+                               "%s", self.node_name, key, claim_name)
+                return False
+            alloc = (claim.get("status") or {}).get("allocation") or {}
+            if alloc.get("nodeName") != self.node_name:
+                # PreBind persists the allocation before binding, so this
+                # is transient at worst; the pod re-syncs on claim update.
+                return False
+            self.ledger.allocate(key, ref.get("name") or claim_name,
+                                 list(alloc.get("devices") or []))
+        return True
+
+    async def _mark_running(self, key: str, pod: dict) -> None:
+        complete_after = [None]
+
+        def mutate(obj):
+            if (obj.get("status") or {}).get("phase") != "Pending":
+                return None
+            self._ip_seq += 1
+            hi, lo = divmod(self._ip_seq, 254)
+            status = obj.setdefault("status", {})
+            status["phase"] = "Running"
+            status.setdefault(
+                "podIP",
+                f"10.{self._ip_base}.{hi % 256}.{lo + 1}")
+            conds = status.setdefault("conditions", [])
+            if not any(c.get("type") == "Ready" for c in conds):
+                conds.append({"type": "Ready", "status": "True"})
+            complete_after[0] = (obj["metadata"].get("annotations")
+                                 or {}).get(COMPLETE_AFTER_ANN)
+            return obj
+        try:
+            await self.store.guaranteed_update(
+                "pods", key, mutate, return_copy=False)
+        except StoreError:
+            return
+        if complete_after[0] is not None:
+            self._arm_completion(key, complete_after[0])
+
+    def _arm_completion(self, key: str, spec: str) -> None:
+        try:
+            delay = float(spec)
+        except ValueError:
+            return
+        self._armed.add(key)
+        t = asyncio.ensure_future(self._complete_later(key, delay))
+        self._workers.add(t)
+        t.add_done_callback(self._workers.discard)
+
+    async def _complete_later(self, key: str, delay: float) -> None:
+        try:
+            await asyncio.sleep(delay)
+        finally:
+            self._armed.discard(key)
+
+        def mutate(pod):
+            if (pod.get("status") or {}).get("phase") != "Running":
+                return None
+            pod["status"]["phase"] = "Succeeded"
+            return pod
+        try:
+            await self.store.guaranteed_update(
+                "pods", key, mutate, return_copy=False)
+        except StoreError:
+            pass
+
+    # -- heartbeats --------------------------------------------------------
+
+    async def _lease_loop(self) -> None:
+        while not self._stopped:
+            try:
+                await self.store.guaranteed_update(
+                    "leases", f"kube-node-lease/{self.node_name}",
+                    self._renew)
+            except NotFound:
+                lease = new_object("Lease", self.node_name,
+                                   "kube-node-lease",
+                                   spec={"renewTime": 0})
+                try:
+                    await self.store.create("leases", lease)
+                except StoreError:
+                    pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("agent %s: lease renew failed",
+                                 self.node_name)
+            await asyncio.sleep(self.lease_period)
+
+    @staticmethod
+    def _renew(lease: dict) -> dict:
+        lease.setdefault("spec", {})
+        lease["spec"]["renewTime"] = lease["spec"].get("renewTime", 0) + 1
+        return lease

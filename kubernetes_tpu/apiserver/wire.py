@@ -188,19 +188,23 @@ class _Conn(asyncio.Protocol):
         self.server._conns.discard(self)
 
     def data_received(self, data: bytes) -> None:
-        self.buf.extend(data)
-        while True:
-            if len(self.buf) < 4:
-                return
-            n = _LEN.unpack_from(self.buf)[0]
+        # Offset-scan then ONE tail compaction: a coalesced read can hold
+        # hundreds of frames, and `del buf[:4+n]` per frame is an O(bytes)
+        # memmove each time — quadratic over the burst.
+        buf = self.buf
+        buf.extend(data)
+        end = len(buf)
+        ofs = 0
+        while end - ofs >= 4:
+            n = _LEN.unpack_from(buf, ofs)[0]
             if n > _MAX_FRAME:
                 logger.error("wire: oversized frame (%d bytes); closing", n)
                 self.transport.close()
                 return
-            if len(self.buf) < 4 + n:
-                return
-            payload = bytes(self.buf[4:4 + n])
-            del self.buf[:4 + n]
+            if end - ofs - 4 < n:
+                break
+            payload = bytes(buf[ofs + 4:ofs + 4 + n])
+            ofs += 4 + n
             try:
                 frame, self._mp = _decode_frame(payload)
             except Exception:
@@ -208,6 +212,8 @@ class _Conn(asyncio.Protocol):
                 self.transport.close()
                 return
             asyncio.ensure_future(self._handle(frame))
+        if ofs:
+            del buf[:ofs]
 
     # -- batched writes ----------------------------------------------------
 
@@ -416,7 +422,8 @@ class _Conn(asyncio.Protocol):
             lst = await store.list(
                 resource, namespace=args.get("namespace"),
                 selector=sel, limit=int(args.get("limit") or 0),
-                continue_key=args.get("continue"))
+                continue_key=args.get("continue"),
+                fields=args.get("fields") or None)
             return {"items": lst.items, "rv": lst.resource_version}
         if op == "kinds":
             return {"kinds": store.kind_map(),
@@ -441,7 +448,8 @@ class _Conn(asyncio.Protocol):
         try:
             watch = await self.server.store.watch(
                 resource, resource_version=int(args.get("rv") or 0),
-                namespace=args.get("namespace"), selector=sel)
+                namespace=args.get("namespace"), selector=sel,
+                fields=args.get("fields") or None)
         except Expired as e:
             self.send(_encode_reply([wid, "exp", str(e)], self._mp))
             return
@@ -610,16 +618,34 @@ class _ClientProto(asyncio.Protocol):
         self.owner._conn_lost(exc)
 
     def data_received(self, data: bytes) -> None:
-        self.buf.extend(data)
-        while True:
-            if len(self.buf) < 4:
-                return
-            n = _LEN.unpack_from(self.buf)[0]
-            if len(self.buf) < 4 + n:
-                return
-            payload = bytes(self.buf[4:4 + n])
-            del self.buf[:4 + n]
-            self.owner._on_frame(_decode_frame(payload)[0])
+        # Offset-scan + single compaction (see _Conn.data_received): the
+        # server's watch-push bursts coalesce into large reads. The
+        # compaction runs in `finally` so a decode/handler error cannot
+        # leave already-delivered frames at the buffer head (they would
+        # replay on the next read); an undecodable frame is fatal to the
+        # connection, mirroring the server side.
+        buf = self.buf
+        buf.extend(data)
+        end = len(buf)
+        ofs = 0
+        try:
+            while end - ofs >= 4:
+                n = _LEN.unpack_from(buf, ofs)[0]
+                if end - ofs - 4 < n:
+                    break
+                payload = bytes(buf[ofs + 4:ofs + 4 + n])
+                ofs += 4 + n
+                try:
+                    frame = _decode_frame(payload)[0]
+                except Exception:
+                    logger.error("wire client: undecodable frame; closing")
+                    if self.transport is not None:
+                        self.transport.close()
+                    return
+                self.owner._on_frame(frame)
+        finally:
+            if ofs:
+                del buf[:ofs]
 
 
 class _WireWatch:
@@ -885,17 +911,20 @@ class WireStore:
         self, resource: str, namespace: str | None = None,
         selector: Selector | None = None, limit: int = 0,
         continue_key: str | None = None,
+        fields: Mapping[str, str] | None = None,
     ) -> ListResult:
         resp = await self._call("list", resource, {
             "namespace": namespace,
             "selector": selector_to_string(selector) or None,
-            "limit": limit or 0, "continue": continue_key})
+            "limit": limit or 0, "continue": continue_key,
+            "fields": dict(fields) if fields else None})
         return ListResult(items=resp["items"],
                           resource_version=int(resp["rv"]))
 
     async def watch(
         self, resource: str, resource_version: int = 0,
         namespace: str | None = None, selector: Selector | None = None,
+        fields: Mapping[str, str] | None = None,
         **_kw,
     ) -> AsyncIterator[Event]:
         await self._ensure()
@@ -905,7 +934,8 @@ class WireStore:
         self._watches[wid] = w
         self._send([wid, "watch", resource, {
             "rv": resource_version or 0, "namespace": namespace,
-            "selector": selector_to_string(selector) or None}])
+            "selector": selector_to_string(selector) or None,
+            "fields": dict(fields) if fields else None}])
 
         async def gen() -> AsyncIterator[Event]:
             try:

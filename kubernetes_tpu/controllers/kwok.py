@@ -14,7 +14,11 @@ import asyncio
 import logging
 
 from kubernetes_tpu.api.meta import namespaced_name, new_object
-from kubernetes_tpu.api.types import make_node, make_resource_slice
+from kubernetes_tpu.api.types import (
+    make_node,
+    make_resource_slice,
+    template_devices,
+)
 from kubernetes_tpu.client import InformerFactory, ResourceEventHandler
 from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.store.mvcc import AlreadyExists, NotFound, StoreError
@@ -95,32 +99,12 @@ class KwokController(Controller):
     def _template_devices(self) -> list[dict]:
         """Device list derived from the template ONCE (50k-node runs
         register 50k slices; re-parsing per node would be 400k throwaway
-        dict builds). Names carry the FULL resource with '/' → '--'
-        (dots kept) so two vendors' same-suffix resources can't collide
-        in the consumed-device set."""
-        if self._device_list is not None:
-            return self._device_list
-        alloc = self.node_template.get("allocatable") or {}
-        devices: list[dict] = []
-        for res, count in alloc.items():
-            if "/" not in res:
-                continue  # core resources are not devices
-            short = res.rsplit("/", 1)[1]
-            # '/' alone is mapped (dots stay) so distinct resources can't
-            # sanitize to the same device-name prefix.
-            prefix = res.replace("/", "--")
-            try:
-                n = int(str(count))
-            except ValueError:
-                continue
-            for k in range(n):
-                devices.append({
-                    "name": f"{prefix}-{k}",
-                    "attributes": {
-                        "type": short,
-                        "numa": str(k * self.device_zones // n)}})
-        self._device_list = devices
-        return devices
+        dict builds). Naming/zoning convention: api.types.template_devices
+        (shared with the hollow-kubelet agent)."""
+        if self._device_list is None:
+            self._device_list = template_devices(
+                self.node_template.get("allocatable"), self.device_zones)
+        return self._device_list
 
     async def _publish_devices(self, node_name: str) -> None:
         """Model HOW `google.com/tpu: 8` arrives: the kubelet device

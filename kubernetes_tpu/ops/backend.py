@@ -31,6 +31,7 @@ stateful constraints); violators are returned unassigned and requeue — the
 from __future__ import annotations
 
 import logging
+import os
 from functools import partial
 from typing import Mapping, Sequence
 
@@ -53,10 +54,12 @@ DEVICE_FILTER_PLUGINS = {"NodeResourcesFit", "TaintToleration"}
 DEVICE_SCORE_PLUGINS = {
     "NodeResourcesFit", "NodeResourcesBalancedAllocation", "TaintToleration"}
 
-#: In-flight chunk solves before a fetch is forced. Depth 2 lets the fetch
-#: round-trip of chunk k hide behind the solves of chunks k+1 and k+2 —
-#: chunk results have no host-side dependency until verify.
-_PIPELINE_DEPTH = 2
+#: In-flight chunk solves before a fetch is forced. The relay costs ~24ms
+#: per transfer each way regardless of size, so a chunk's upload+fetch
+#: round trips span SEVERAL chunk solves: depth 4 (5 in flight) measured
+#: ~10% over depth 2 on the 5k wire bench (r5 sweep). Env-tunable for
+#: sweeps (KTPU_PIPELINE_DEPTH).
+_PIPELINE_DEPTH = int(os.environ.get("KTPU_PIPELINE_DEPTH", "4") or "4")
 
 #: Gang (PodGroup) slots per chunk for the solver's all-or-nothing masking;
 #: fixed so the jit signature is stable. Overflow gangs keep the Permit

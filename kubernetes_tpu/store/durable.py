@@ -71,11 +71,13 @@ class WriteAheadLog:
         if ev.type == "BOOKMARK" or self.broken:
             return
         record = [ev.rv, ev.type, resource, ev.object]
-        if ev.prev_labels is not None:
-            # Label-transition info survives replay, so a selector watch
-            # resuming across restart still sees synthesized DELETED
-            # events (cacher prevObject semantics).
+        if ev.prev_labels is not None or ev.prev_fields is not None:
+            # Label/field-transition info survives replay, so selector and
+            # field watches resuming across restart still see synthesized
+            # ADDED/DELETED transitions (cacher prevObject semantics).
             record.append(ev.prev_labels)
+            if ev.prev_fields is not None:
+                record.append(ev.prev_fields)
         try:
             self._fh.write(json.dumps(record, separators=(",", ":"))
                            + "\n")
@@ -253,7 +255,8 @@ def _latest(directory: str, pattern: re.Pattern) -> list[tuple[int, str]]:
     return sorted(out)
 
 
-def _iter_wal(path: str) -> Iterable[tuple[int, str, str, dict, dict | None]]:
+def _iter_wal(path: str) -> Iterable[
+        tuple[int, str, str, dict, dict | None, dict | None]]:
     with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
@@ -263,6 +266,7 @@ def _iter_wal(path: str) -> Iterable[tuple[int, str, str, dict, dict | None]]:
                 rec = json.loads(line)
                 rv, ev_type, resource, obj = rec[:4]
                 prev_labels = rec[4] if len(rec) > 4 else None
+                prev_fields = rec[5] if len(rec) > 5 else None
             except (json.JSONDecodeError, ValueError, IndexError):
                 # Torn tail write from a crash: everything before it is
                 # durable; the torn record never committed to callers
@@ -270,7 +274,7 @@ def _iter_wal(path: str) -> Iterable[tuple[int, str, str, dict, dict | None]]:
                 logger.warning("WAL %s: torn record, truncating replay",
                                path)
                 return
-            yield int(rv), ev_type, resource, obj, prev_labels
+            yield int(rv), ev_type, resource, obj, prev_labels, prev_fields
 
 
 def recover_store(directory: str,
@@ -302,7 +306,8 @@ def recover_store(directory: str,
     for base_rv, path in _latest(directory, _WAL_RE):
         if base_rv < snap_rv:
             continue
-        for rv, ev_type, resource, obj, prev_labels in _iter_wal(path):
+        for rv, ev_type, resource, obj, prev_labels, prev_fields \
+                in _iter_wal(path):
             if rv <= store.resource_version and rv <= snap_rv:
                 continue  # already inside the snapshot
             table = store._table(resource)
@@ -313,7 +318,8 @@ def recover_store(directory: str,
                 table[key] = obj
             store._rv = max(store._rv, rv)
             store._events.append(
-                (resource, Event(ev_type, obj, rv, prev_labels)))
+                (resource, Event(ev_type, obj, rv, prev_labels,
+                                 prev_fields)))
     # Watch-resume window: everything since the snapshot is replayable;
     # anything older is compacted (410 Expired → relist).
     store._first_retained_rv = snap_rv + 1

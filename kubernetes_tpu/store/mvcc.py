@@ -81,6 +81,10 @@ class Event:
     object: dict
     rv: int
     prev_labels: dict | None = None
+    #: pre-update values of registered field-selector fields (e.g. pods
+    #: spec.nodeName) so field watchers see enter/leave transitions the
+    #: same way label watchers do.
+    prev_fields: dict | None = None
 
     def to_wire(self) -> dict:
         return {"type": self.type, "object": self.object}
@@ -92,7 +96,25 @@ class _WatchChannel:
     resource: str
     namespace: str | None
     selector: Selector | None
+    fields: Mapping[str, str] | None = None
     closed: bool = False
+
+
+def _field_value(obj: Mapping, dotted: str):
+    """Walk `spec.nodeName`-style paths; missing → '' (the apiserver
+    treats absent fields as empty strings in field selectors)."""
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, Mapping):
+            return ""
+        cur = cur.get(part)
+        if cur is None:
+            return ""
+    return cur if isinstance(cur, str) else str(cur)
+
+
+def _fields_match(fields: Mapping[str, str], obj: Mapping) -> bool:
+    return all(_field_value(obj, f) == v for f, v in fields.items())
 
 
 @dataclass
@@ -170,6 +192,12 @@ class MVCCStore:
         self.custom_cluster_scoped: set[str] = set()
         #: durability sinks (add_event_sink) — called per committed event.
         self._event_sinks: list = []
+        #: resource -> fields whose PRE-update values ride each MODIFIED
+        #: event so field watchers get enter/leave transitions. pods
+        #: spec.nodeName is the registered default — the kubelet's watch
+        #: shape (the reference apiserver indexes exactly this field).
+        self._tracked_fields: dict[str, tuple[str, ...]] = {
+            "pods": ("spec.nodeName", "status.phase")}
 
     # -- helpers -----------------------------------------------------------
 
@@ -234,10 +262,42 @@ class MVCCStore:
         if ev.type == "DELETED":
             return ev if (cur or prev) else None
         if cur and not prev:
-            return Event("ADDED", ev.object, ev.rv, ev.prev_labels)
+            return Event("ADDED", ev.object, ev.rv, ev.prev_labels,
+                         ev.prev_fields)
         if prev and not cur:
-            return Event("DELETED", ev.object, ev.rv, ev.prev_labels)
+            return Event("DELETED", ev.object, ev.rv, ev.prev_labels,
+                         ev.prev_fields)
         return ev if cur else None
+
+    @staticmethod
+    def _select_fields(ev: Event, fields: Mapping[str, str] | None
+                       ) -> Event | None:
+        """Field-selector twin of _select_event: enter ⇒ ADDED, leave ⇒
+        DELETED (how the reference cacher serves `spec.nodeName=` watches
+        to kubelets — a bind looks like ADDED to the node's agent)."""
+        if not fields:
+            return ev
+        cur = _fields_match(fields, ev.object)
+        if ev.prev_fields is not None:
+            prev = all(ev.prev_fields.get(f, _field_value(ev.object, f)) == v
+                       for f, v in fields.items())
+        else:
+            prev = cur if ev.type != "ADDED" else False
+        if ev.type == "DELETED":
+            return ev if (cur or prev) else None
+        if cur and not prev:
+            return Event("ADDED", ev.object, ev.rv, ev.prev_labels,
+                         ev.prev_fields)
+        if prev and not cur:
+            return Event("DELETED", ev.object, ev.rv, ev.prev_labels,
+                         ev.prev_fields)
+        return ev if cur else None
+
+    def _select_for(self, ev: Event, chan: _WatchChannel) -> Event | None:
+        selected = self._select_event(ev, chan.selector)
+        if selected is None:
+            return None
+        return self._select_fields(selected, chan.fields)
 
     def _dispatch(self, resource: str, ev: Event) -> None:
         for w in self._watchers:
@@ -245,7 +305,7 @@ class MVCCStore:
                 continue
             if w.namespace and namespace_of(ev.object) != w.namespace:
                 continue
-            selected = self._select_event(ev, w.selector)
+            selected = self._select_for(ev, w)
             if selected is None:
                 continue
             w.queue.put_nowait(selected)
@@ -362,10 +422,14 @@ class MVCCStore:
         rv = self._next_rv()
         obj["metadata"]["resourceVersion"] = str(rv)
         prev_labels = dict(current.get("metadata", {}).get("labels") or {})
+        tracked = self._tracked_fields.get(resource)
+        prev_fields = {f: _field_value(current, f)
+                       for f in tracked} if tracked else None
         obj = _maybe_freeze(obj)
         table[key] = obj
         # Shared-object discipline: see create().
-        self._record(resource, Event("MODIFIED", obj, rv, prev_labels))
+        self._record(resource,
+                     Event("MODIFIED", obj, rv, prev_labels, prev_fields))
         return deep_copy(obj) if return_copy else None
 
     async def guaranteed_update(
@@ -424,6 +488,7 @@ class MVCCStore:
         selector: Selector | None = None,
         limit: int = 0,
         continue_key: str | None = None,
+        fields: Mapping[str, str] | None = None,
     ) -> ListResult:
         """Consistent LIST with optional etcd-style limit/continue paging."""
         table = self._table(resource)
@@ -439,6 +504,8 @@ class MVCCStore:
                 obj.get("metadata", {}).get("labels")
             ):
                 continue
+            if fields and not _fields_match(fields, obj):
+                continue
             items.append(deep_copy(obj))
             if limit and len(items) >= limit:
                 break
@@ -453,6 +520,7 @@ class MVCCStore:
         namespace: str | None = None,
         selector: Selector | None = None,
         *,
+        fields: Mapping[str, str] | None = None,
         bookmarks: bool = True,
     ) -> AsyncIterator[Event]:
         """Stream events after `resource_version`.
@@ -468,7 +536,7 @@ class MVCCStore:
             )
         chan = _WatchChannel(
             queue=asyncio.Queue(), resource=resource,
-            namespace=namespace, selector=selector,
+            namespace=namespace, selector=selector, fields=fields or None,
         )
         # Replay history strictly after rv, then go live. Registration happens
         # before replay snapshot iteration completes atomically (single loop),
@@ -485,7 +553,7 @@ class MVCCStore:
                 for ev in replay:
                     if chan.namespace and namespace_of(ev.object) != chan.namespace:
                         continue
-                    selected = self._select_event(ev, chan.selector)
+                    selected = self._select_for(ev, chan)
                     if selected is None:
                         continue
                     yield selected

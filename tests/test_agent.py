@@ -1,0 +1,382 @@
+"""Hollow-kubelet node agent (kubernetes_tpu/agent): field-filtered
+watch source, per-pod workers, DRA device Allocate with checkpoint,
+restart recovery.
+
+Reference semantics mirrored: pkg/kubelet syncLoop/pod_workers
+(serialized per-pod, latest wins), cm/devicemanager Allocate +
+checkpointmanager (allocations survive kubelet restart), kubemark
+hollow kubelet (status transitions stand in for a runtime), and the
+apiserver's `spec.nodeName=` field selector the kubelet watches with.
+"""
+
+import asyncio
+import os
+import tempfile
+import unittest
+
+from kubernetes_tpu.agent import DeviceLedger, NodeAgent
+from kubernetes_tpu.api.types import (
+    make_device_class,
+    make_node,
+    make_pod,
+    make_resource_claim,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(pred, timeout=8.0, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        got = await pred()
+        if got:
+            return got
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestFieldSelectors(unittest.TestCase):
+    """Store-side field selectors: the kubelet's watch shape."""
+
+    def test_list_by_node_name(self):
+        async def body():
+            store = new_cluster_store()
+            try:
+                await store.create("pods", make_pod("a"))
+                b = make_pod("b")
+                b["spec"]["nodeName"] = "n1"
+                await store.create("pods", b)
+                lst = await store.list(
+                    "pods", fields={"spec.nodeName": "n1"})
+                self.assertEqual(
+                    [p["metadata"]["name"] for p in lst.items], ["b"])
+            finally:
+                store.stop()
+        run(body())
+
+    def test_bind_enters_field_watch_as_added(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            try:
+                await store.create("nodes", make_node("n1"))
+                await store.create("pods", make_pod("p"))
+                w = await store.watch(
+                    "pods", resource_version=store.resource_version,
+                    fields={"spec.nodeName": "n1"})
+                # Unbound churn is invisible to the node's watch.
+                await store.guaranteed_update(
+                    "pods", "default/p",
+                    lambda o: {**o, "metadata": {
+                        **o["metadata"],
+                        "labels": {"x": "y"}}})
+                await store.subresource(
+                    "pods", "default/p", "binding",
+                    {"target": {"name": "n1"}})
+                ev = await asyncio.wait_for(w.__anext__(), 5)
+                self.assertEqual(ev.type, "ADDED")  # enter ⇒ ADDED
+                self.assertEqual(ev.object["spec"]["nodeName"], "n1")
+                await store.delete("pods", "default/p")
+                ev = await asyncio.wait_for(w.__anext__(), 5)
+                self.assertEqual(ev.type, "DELETED")
+                await w.aclose()
+            finally:
+                store.stop()
+        run(body())
+
+
+class TestDeviceLedger(unittest.TestCase):
+    def test_checkpoint_roundtrip_and_conflict(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ck.json")
+            led = DeviceLedger(path, "n1")
+            led.load()
+            led.allocate("default/p1", "c0", ["dev-0", "dev-1"])
+            led.allocate("default/p2", "c0", ["dev-2"])
+            with self.assertRaises(ValueError):
+                led.allocate("default/p3", "c0", ["dev-1"])  # taken
+            # Restart: a fresh ledger restores the same state.
+            led2 = DeviceLedger(path, "n1")
+            led2.load()
+            self.assertEqual(led2.in_use(), {"dev-0", "dev-1", "dev-2"})
+            self.assertEqual(led2.get("default/p1"),
+                             {"c0": ["dev-0", "dev-1"]})
+            # Reconcile drops departed pods and persists.
+            self.assertEqual(led2.reconcile({"default/p1"}), ["default/p2"])
+            led3 = DeviceLedger(path, "n1")
+            led3.load()
+            self.assertEqual(led3.in_use(), {"dev-0", "dev-1"})
+
+    def test_corrupt_checkpoint_starts_empty(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ck.json")
+            with open(path, "w") as f:
+                f.write("{truncated")
+            led = DeviceLedger(path, "n1")
+            led.load()
+            self.assertEqual(led.in_use(), set())
+
+
+class AgentHarness:
+    """Store + scheduler + N in-process agents (no kwok)."""
+
+    def __init__(self, agents=2, checkpoint_dir=None, template=None):
+        self.n = agents
+        self.dir = checkpoint_dir
+        self.template = template or {
+            "allocatable": {"cpu": "4", "memory": "16Gi", "pods": "32"}}
+
+    async def __aenter__(self):
+        self.store = new_cluster_store()
+        install_core_validation(self.store)
+        self.agents = []
+        for i in range(self.n):
+            a = NodeAgent(self.store, f"agent-n{i}",
+                          checkpoint_dir=self.dir or ".",
+                          node_template=self.template)
+            await a.start()
+            self.agents.append(a)
+        self.sched = Scheduler(self.store, seed=7)
+        self.factory = InformerFactory(self.store)
+        await self.sched.setup_informers(self.factory)
+        self.factory.start()
+        await self.factory.wait_for_sync()
+        self.run_task = asyncio.ensure_future(self.sched.run(batch_size=16))
+        return self
+
+    async def __aexit__(self, *exc):
+        self.run_task.cancel()
+        for a in self.agents:
+            await a.stop()
+        self.factory.stop()
+        self.store.stop()
+
+
+class TestNodeAgent(unittest.TestCase):
+    def test_agents_register_and_run_pods(self):
+        async def body():
+            with tempfile.TemporaryDirectory() as d:
+                async with AgentHarness(agents=2, checkpoint_dir=d) as h:
+                    for i in range(6):
+                        await h.store.create("pods", make_pod(
+                            f"w{i}",
+                            requests={"cpu": "100m", "memory": "100Mi"}))
+
+                    async def all_running():
+                        lst = await h.store.list("pods")
+                        phases = [p.get("status", {}).get("phase")
+                                  for p in lst.items]
+                        return all(ph == "Running"
+                                   for ph in phases) and len(phases) == 6
+                    await wait_for(all_running, msg="pods Running via agents")
+                    # Every pod landed on an agent node and got an IP.
+                    lst = await h.store.list("pods")
+                    for p in lst.items:
+                        self.assertTrue(
+                            p["spec"]["nodeName"].startswith("agent-n"))
+                        self.assertTrue(p["status"].get("podIP"))
+        run(body())
+
+    def test_dra_allocate_checkpoints_and_survives_restart(self):
+        async def body():
+            with tempfile.TemporaryDirectory() as d:
+                template = {"allocatable": {
+                    "cpu": "4", "memory": "16Gi", "pods": "32",
+                    "ktpu.io/tpu": "4"}}
+                async with AgentHarness(agents=1, checkpoint_dir=d,
+                                        template=template) as h:
+                    await h.store.create(
+                        "deviceclasses",
+                        make_device_class("tpu", {"type": "tpu"}))
+                    await h.store.create(
+                        "resourceclaims", make_resource_claim(
+                            "c1", requests=[{
+                                "name": "tpus",
+                                "deviceClassName": "tpu", "count": 2}]))
+                    await h.store.create("pods", make_pod(
+                        "dra-pod",
+                        requests={"cpu": "100m"},
+                        resource_claims=[{
+                            "name": "tpus",
+                            "resourceClaimName": "c1"}]))
+
+                    agent = h.agents[0]
+
+                    async def allocated():
+                        return agent.ledger.get("default/dra-pod") or None
+                    alloc = await wait_for(allocated, msg="device Allocate")
+                    self.assertEqual(len(alloc["tpus"]), 2)
+                    ck = agent.ledger.path
+                    self.assertTrue(os.path.exists(ck))
+
+                    # Agent restart: allocations restore from checkpoint
+                    # (pod still bound → reconcile keeps it).
+                    await agent.stop()
+                    a2 = NodeAgent(h.store, agent.node_name,
+                                   checkpoint_dir=d,
+                                   node_template=template)
+                    await a2.start()
+                    try:
+                        self.assertEqual(
+                            a2.ledger.get("default/dra-pod"), alloc)
+                        # Deleting the pod releases its devices.
+                        await h.store.delete("pods", "default/dra-pod")
+
+                        async def released():
+                            return not a2.ledger.in_use() or None
+                        await wait_for(released, msg="device release")
+                    finally:
+                        await a2.stop()
+        run(body())
+
+
+
+    def test_complete_after_rearms_across_restart(self):
+        async def body():
+            with tempfile.TemporaryDirectory() as d:
+                store = new_cluster_store()
+                install_core_validation(store)
+                try:
+                    a = NodeAgent(store, "ra-n0", checkpoint_dir=d)
+                    await a.start()
+                    pod = make_pod("job1", requests={"cpu": "100m"})
+                    pod["metadata"]["annotations"] = {
+                        "kwok.x-k8s.io/complete-after": "0.3"}
+                    await store.create("pods", pod)
+                    await store.subresource(
+                        "pods", "default/job1", "binding",
+                        {"target": {"name": "ra-n0"}})
+
+                    async def running():
+                        p = await store.get("pods", "default/job1")
+                        return (p["status"].get("phase")
+                                == "Running") or None
+                    await wait_for(running, msg="Running")
+                    # Restart BEFORE the completion timer fires.
+                    await a.stop()
+                    a2 = NodeAgent(store, "ra-n0", checkpoint_dir=d)
+                    await a2.start()
+                    try:
+                        async def succeeded():
+                            p = await store.get("pods", "default/job1")
+                            return (p["status"].get("phase")
+                                    == "Succeeded") or None
+                        await wait_for(succeeded,
+                                       msg="re-armed completion")
+                    finally:
+                        await a2.stop()
+                finally:
+                    store.stop()
+        run(body())
+
+
+class TestAgentOverWire(unittest.TestCase):
+    """Agents as wire clients of a real apiserver (the process shape),
+    in-process for speed; the subprocess binary is covered below."""
+
+    def test_agent_over_wire_schedules_and_syncs(self):
+        async def body():
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            api = APIServer(backing)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            with tempfile.TemporaryDirectory() as d:
+                agent_store = WireStore(wire.target, user_agent="agent")
+                sched_store = WireStore(wire.target, user_agent="sched")
+                agent = NodeAgent(agent_store, "wire-n0",
+                                  checkpoint_dir=d)
+                await agent.start()
+                sched = Scheduler(sched_store, seed=3)
+                factory = InformerFactory(sched_store)
+                await sched.setup_informers(factory)
+                factory.start()
+                await factory.wait_for_sync()
+                task = asyncio.ensure_future(sched.run(batch_size=8))
+                try:
+                    await sched_store.create("pods", make_pod(
+                        "wp", requests={"cpu": "100m"}))
+
+                    async def running():
+                        p = await sched_store.get("pods", "default/wp")
+                        return (p.get("status", {}).get("phase")
+                                == "Running") or None
+                    await wait_for(running, msg="pod Running over wire")
+                finally:
+                    task.cancel()
+                    await agent.stop()
+                    factory.stop()
+                    await agent_store.close()
+                    await sched_store.close()
+                    await wire.stop()
+                    await api.stop()
+                    backing.stop()
+        run(body())
+
+
+class TestAgentBinary(unittest.TestCase):
+    """`python -m kubernetes_tpu.agent` as a REAL subprocess against a
+    wire listener — the per-node process shape (SURVEY §2.1 row 14)."""
+
+    def test_subprocess_agent_runs_pod_and_checkpoint_survives(self):
+        async def body():
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            api = APIServer(backing)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            client = WireStore(wire.target)
+            with tempfile.TemporaryDirectory() as d:
+                import sys
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "-m", "kubernetes_tpu.agent",
+                    "--node", "proc-n0", "--server", wire.target,
+                    "--checkpoint-dir", d,
+                    "--allocatable", "cpu=4,memory=16Gi,pods=32",
+                    stdout=asyncio.subprocess.DEVNULL,
+                    stderr=asyncio.subprocess.DEVNULL)
+                try:
+                    async def node_up():
+                        lst = await client.list("nodes")
+                        return any(n["metadata"]["name"] == "proc-n0"
+                                   for n in lst.items) or None
+                    await wait_for(node_up, timeout=15,
+                                   msg="subprocess agent registered")
+                    # Bind a pod to it directly (no scheduler needed).
+                    await client.create("pods", make_pod(
+                        "sp", requests={"cpu": "100m"}))
+                    await client.subresource(
+                        "pods", "default/sp", "binding",
+                        {"target": {"name": "proc-n0"}})
+
+                    async def running():
+                        p = await client.get("pods", "default/sp")
+                        return (p.get("status", {}).get("phase")
+                                == "Running") or None
+                    await wait_for(running, timeout=15,
+                                   msg="subprocess agent ran pod")
+                finally:
+                    proc.terminate()
+                    try:
+                        await asyncio.wait_for(proc.wait(), 10)
+                    except asyncio.TimeoutError:
+                        proc.kill()
+                        await proc.wait()
+            await client.close()
+            await wire.stop()
+            await api.stop()
+            backing.stop()
+        run(body())
+
+
+if __name__ == "__main__":
+    unittest.main()
