@@ -40,9 +40,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", choices=PRESETS, default="5k")
     ap.add_argument("--backend", choices=["host", "tpu"], default="tpu")
-    ap.add_argument("--batch-size", type=int, default=8192,
+    ap.add_argument("--batch-size", type=int, default=16384,
                     help="pods popped per scheduling super-batch; the "
-                         "backend chunks + pipelines internally")
+                         "backend chunks + pipelines internally. One "
+                         "super-batch per measured burst avoids the "
+                         "batch-boundary stall (tensor delta + used-state "
+                         "re-upload + first-chunk latency with no binding "
+                         "work to overlap)")
     ap.add_argument("--chunk", type=int, default=1024,
                     help="backend solve chunk (jit batch signature); "
                          "smaller chunks pipeline better against binding "

@@ -956,6 +956,14 @@ class TPUBackend:
         ctx = self._start(pods, snapshot, fwk)
         for run in self._pipeline(ctx):
             got = await asyncio.to_thread(np.asarray, run["assign_d"])
+            if (got[: run["batch"].p_real] < 0).any():
+                # Solver failures → _finalize_chunk will need the unsat
+                # planes for diagnostics. Fetch them HERE, off-loop and
+                # overlapped (copy_to_host_async both, then block in the
+                # worker): the synchronous np.asarray inside finalize
+                # stalled the event loop one relay round-trip per plane —
+                # over half the wall on dense-failure (preemption) waves.
+                await asyncio.to_thread(self._fetch_diag_planes, run)
             self._finalize_chunk(run, got, ctx)
             yield run["pods"], ctx
 
@@ -1631,11 +1639,30 @@ class TPUBackend:
                      if ctx.assignments.get(pi.key) is None
                      and pi.key not in ctx.diagnostics]
         if need_diag:
+            fit0 = run.get("fit0_np")
+            if fit0 is None:
+                fit0 = np.asarray(run["fit0_d"])
+            taint_ok = run.get("taint_ok_np")
+            if taint_ok is None:
+                taint_ok = np.asarray(run["taint_ok_d"])
             self._build_diagnostics(
-                need_diag, pods, ctx.ct, batch,
-                np.asarray(run["fit0_d"]), np.asarray(run["taint_ok_d"]),
+                need_diag, pods, ctx.ct, batch, fit0, taint_ok,
                 run["host_filter_fail"], ctx.params["filter_names"],
                 ctx.diagnostics, run["unknown_res"])
+
+    @staticmethod
+    def _fetch_diag_planes(run: dict) -> None:
+        """Worker-thread fetch of the diagnostic unsat planes: start both
+        device→host copies before blocking so the relay trips overlap."""
+        for k in ("fit0_d", "taint_ok_d"):
+            a = run.get(k)
+            if a is not None and hasattr(a, "copy_to_host_async"):
+                try:
+                    a.copy_to_host_async()
+                except Exception:
+                    pass
+        run["fit0_np"] = np.asarray(run["fit0_d"])
+        run["taint_ok_np"] = np.asarray(run["taint_ok_d"])
 
     # -- verification --------------------------------------------------------
 

@@ -228,6 +228,15 @@ class SchedulingQueue:
     async def move_all(self, event: ClusterEvent) -> int:
         """Cluster event: re-activate unschedulable pods whose QueueingHints
         say the event may help (MoveAllToActiveOrBackoffQueue)."""
+        return await self.move_all_batch([event])
+
+    async def move_all_batch(self, events: list[ClusterEvent]) -> int:
+        """One pass over the parked pods for a TICK's worth of coalesced
+        events: a preemption wave deletes thousands of victims in bursts,
+        and scanning every unschedulable pod once per delete event made
+        event handling O(events × parked) — the batch scan moves a pod if
+        ANY of the tick's events hints QUEUE, the same outcome as the
+        sequential per-event scans over an unchanged queue state."""
         moved = 0
         async with self._cond:
             # Cycles currently in flight may be failing for a reason this
@@ -244,7 +253,8 @@ class SchedulingQueue:
                     moved += 1
             for key in list(self._unschedulable):
                 pi, _ = self._unschedulable[key]
-                if not self._hint_says_queue(pi, event):
+                if not any(self._hint_says_queue(pi, event)
+                           for event in events):
                     continue
                 del self._unschedulable[key]
                 if pi.attempts > 0 and self._backoff_duration(pi) > 0:

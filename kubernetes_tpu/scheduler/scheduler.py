@@ -133,7 +133,27 @@ class Scheduler:
         self._binding_tasks: set[asyncio.Task] = set()
         self._permit_waiters: dict[str, asyncio.Future] = {}
         self._stop = False
+        #: tick-coalesced cluster events (label-deduped) for ONE
+        #: move_all_batch scan per loop tick — see _move_all_soon.
+        self._pending_moves: dict[str, ClusterEvent] = {}
+        self._move_scheduled = False
         self._register_default_hints(default_fwk)
+
+    def _move_all_soon(self, event: ClusterEvent) -> None:
+        """Coalesce same-tick cluster events into one queue scan: an
+        informer burst (e.g. a preemption wave's victim deletes) fires
+        one move_all_batch instead of one full-parked-set scan per event."""
+        self._pending_moves[event.label] = event
+        if not self._move_scheduled:
+            self._move_scheduled = True
+            asyncio.get_event_loop().call_soon(self._drain_moves)
+
+    def _drain_moves(self) -> None:
+        self._move_scheduled = False
+        events = list(self._pending_moves.values())
+        self._pending_moves.clear()
+        if events:
+            asyncio.ensure_future(self.queue.move_all_batch(events))
 
     # ------------------------------------------------------------------
     # wiring (eventhandlers.go addAllEventHandlers)
@@ -160,8 +180,7 @@ class Scheduler:
                 return
             if pi.node_name:
                 self.cache.add_pod(pi)
-                asyncio.ensure_future(
-                    self.queue.move_all(ClusterEvent("Pod", "Add")))
+                self._move_all_soon(ClusterEvent("Pod", "Add"))
             elif self._responsible(pi):
                 asyncio.ensure_future(self.queue.add(pi))
                 # A new PENDING pod can lift gates of other pods (e.g.
@@ -169,8 +188,7 @@ class Scheduler:
                 # the queue when something is actually parked — at perf
                 # scale this fires once per created pod.
                 if self.queue.has_parked():
-                    asyncio.ensure_future(
-                        self.queue.move_all(ClusterEvent("Pod", "Add")))
+                    self._move_all_soon(ClusterEvent("Pod", "Add"))
 
         def on_pod_update(old, new):
             pi = PodInfo(new)
@@ -189,18 +207,15 @@ class Scheduler:
             if obj.get("spec", {}).get("nodeName") or self.cache.is_assumed(key):
                 self.cache.remove_pod(key)
             asyncio.ensure_future(self.queue.delete(key))
-            asyncio.ensure_future(
-                self.queue.move_all(ClusterEvent("Pod", "Delete")))
+            self._move_all_soon(ClusterEvent("Pod", "Delete"))
 
         def on_node_add(obj):
             self.cache.add_node(obj)
-            asyncio.ensure_future(
-                self.queue.move_all(ClusterEvent("Node", "Add")))
+            self._move_all_soon(ClusterEvent("Node", "Add"))
 
         def on_node_update(old, new):
             self.cache.update_node(new)
-            asyncio.ensure_future(
-                self.queue.move_all(ClusterEvent("Node", "Update")))
+            self._move_all_soon(ClusterEvent("Node", "Update"))
 
         def on_node_delete(obj):
             self.cache.remove_node(obj["metadata"]["name"])
@@ -229,8 +244,7 @@ class Scheduler:
 
             def poke(action, kind=kind):
                 def handler(*_args):
-                    asyncio.ensure_future(
-                        self.queue.move_all(ClusterEvent(kind, action)))
+                    self._move_all_soon(ClusterEvent(kind, action))
                 return handler
 
             handlers = {}
@@ -492,27 +506,34 @@ class Scheduler:
                 snapshot = self.cache.update_snapshot()
             return
         elapsed = time.perf_counter() - t0
+        # Assigned pods bind FIRST so the failure wave below sees every
+        # in-batch assume in ONE snapshot; per-failure re-snapshots were
+        # an O(N) walk per preemptor (the wave tensors already account
+        # for in-wave claims — preemption.go's nominated-pod charge).
+        failed: list[PodInfo] = []
         for pi in pods:
             node = assignments.get(pi.key)
             if node:
                 self.metrics.observe_attempt("scheduled", fwk.profile_name, elapsed / len(pods))
                 await self._assume_and_bind(fwk, CycleState(), pi, node)
             else:
-                self.metrics.observe_attempt("unschedulable", fwk.profile_name,
-                                             elapsed / len(pods))
-                statuses = diagnostics.get(pi.key, {})
-                # state+snapshot enable the PostFilter (preemption) branch
-                # — without them the batched path could never preempt.
-                # PreFilter runs first so the dry-run's filters see the
-                # pod's affinity/spread/volume prefilter state (an empty
-                # CycleState would make those filters vacuously pass and
-                # evict victims on nodes the pod can never land on).
-                live = self.cache.update_snapshot()
-                state = CycleState()
-                fwk.run_pre_filter(state, pi, live)
-                await self._handle_failure(
-                    fwk, pi, FitError(pi, len(snapshot), statuses),
-                    statuses, state=state, snapshot=live)
+                failed.append(pi)
+        live = self.cache.update_snapshot() if failed else None
+        for pi in failed:
+            self.metrics.observe_attempt("unschedulable", fwk.profile_name,
+                                         elapsed / len(pods))
+            statuses = diagnostics.get(pi.key, {})
+            # state+snapshot enable the PostFilter (preemption) branch
+            # — without them the batched path could never preempt.
+            # PreFilter runs first so the dry-run's filters see the
+            # pod's affinity/spread/volume prefilter state (an empty
+            # CycleState would make those filters vacuously pass and
+            # evict victims on nodes the pod can never land on).
+            state = CycleState()
+            fwk.run_pre_filter(state, pi, live)
+            await self._handle_failure(
+                fwk, pi, FitError(pi, len(snapshot), statuses),
+                statuses, state=state, snapshot=live)
 
     async def _schedule_via_backend_stream(self, pods: list[PodInfo],
                                            snapshot, fwk, t0: float) -> None:
@@ -559,6 +580,10 @@ class Scheduler:
             now = time.perf_counter()
             elapsed, last_t = now - last_t, now
             n = max(1, len(chunk_pods))
+            # Binds first, then the chunk's failure wave against ONE live
+            # snapshot (see _schedule_via_backend) — per-preemptor
+            # re-snapshots dominated dense preemption waves.
+            failed = []
             for pi in chunk_pods:
                 done.add(pi.key)
                 node = ctx.assignments.get(pi.key)
@@ -568,23 +593,25 @@ class Scheduler:
                     await self._assume_and_bind(
                         fwk, CycleState(), pi, node)
                 else:
-                    self.metrics.observe_attempt(
-                        "unschedulable", fwk.profile_name, elapsed / n)
-                    statuses = ctx.diagnostics.get(pi.key, {})
-                    live = self.cache.update_snapshot()
-                    state = CycleState()
-                    fwk.run_pre_filter(state, pi, live)
-                    try:
-                        await self._handle_failure(
-                            fwk, pi,
-                            FitError(pi, len(snapshot), statuses),
-                            statuses, state=state, snapshot=live)
-                    except Exception:
-                        # Infrastructure error (e.g. an eviction write
-                        # failed): the pod must not silently vanish.
-                        logger.exception(
-                            "failure handling errored for %s", pi.key)
-                        await self.queue.move_to_backoff(pi)
+                    failed.append(pi)
+            live = self.cache.update_snapshot() if failed else None
+            for pi in failed:
+                self.metrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, elapsed / n)
+                statuses = ctx.diagnostics.get(pi.key, {})
+                state = CycleState()
+                fwk.run_pre_filter(state, pi, live)
+                try:
+                    await self._handle_failure(
+                        fwk, pi,
+                        FitError(pi, len(snapshot), statuses),
+                        statuses, state=state, snapshot=live)
+                except Exception:
+                    # Infrastructure error (e.g. an eviction write
+                    # failed): the pod must not silently vanish.
+                    logger.exception(
+                        "failure handling errored for %s", pi.key)
+                    await self.queue.move_to_backoff(pi)
 
     async def _schedule_host_path(self, pi: PodInfo, snapshot) -> None:
         fwk = self.profiles.get(pi.scheduler_name)
