@@ -47,10 +47,12 @@ def main(argv=None) -> int:
                          "batch-boundary stall (tensor delta + used-state "
                          "re-upload + first-chunk latency with no binding "
                          "work to overlap)")
-    ap.add_argument("--chunk", type=int, default=1024,
-                    help="backend solve chunk (jit batch signature); "
-                         "smaller chunks pipeline better against binding "
-                         "traffic now that assignments stream per chunk")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="OVERRIDE the backend solve chunk (jit batch "
+                         "signature). Default: flagless — the backend's "
+                         "adaptive tuner picks chunk AND pipeline depth "
+                         "from warmup-measured transfer latency and "
+                         "dirty-upload ratio (BASELINE.md r6 envelope)")
     ap.add_argument("--through-apiserver", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="cross the process boundary: workload writes, "
@@ -88,7 +90,7 @@ def main(argv=None) -> int:
     batch = 1
     if DEFAULT_FEATURE_GATES.enabled("TPUScorer"):
         from kubernetes_tpu.ops import TPUBackend
-        backend = TPUBackend(max_batch=args.chunk)
+        backend = TPUBackend(max_batch=args.chunk)  # None = adaptive
         batch = args.batch_size
         args.backend = "tpu"
     else:

@@ -85,7 +85,11 @@ class NodeAgent:
         self._armed: set[str] = set()
         self._stopped = False
         self._ip_seq = 0
-        self._ip_base = (sum(node_name.encode()) % 200) + 16
+        # Pod-IP base: sha256 of the node name — a permutation-sensitive
+        # hash ('n01' vs 'n10' must not share a /16; byte-sum collided).
+        import hashlib
+        self._ip_base = (hashlib.sha256(
+            node_name.encode()).digest()[0] % 200) + 16
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -102,6 +106,11 @@ class NodeAgent:
         if dropped:
             logger.info("agent %s: reclaimed devices of %d departed pods",
                         self.node_name, len(dropped))
+        # Resume the IP sequence past every already-assigned podIP on this
+        # node: _ip_seq resets with the process, but Running pods keep
+        # their IPs — restarting from 0 would re-issue them.
+        for p in lst.items:
+            self._ip_seq = max(self._ip_seq, self._ip_seq_of(p))
         for p in lst.items:
             self._observe(namespaced_name(p), p)
         self._tasks.append(asyncio.ensure_future(
@@ -247,6 +256,20 @@ class NodeAgent:
             return  # claim not ready yet; the claim update re-syncs us
         await self._mark_running(key, pod)
 
+    def _ip_seq_of(self, pod: dict) -> int:
+        """Inverse of _mark_running's podIP formula for OUR base prefix;
+        0 for foreign/absent IPs."""
+        ip = (pod.get("status") or {}).get("podIP") or ""
+        parts = ip.split(".")
+        if len(parts) != 4 or parts[0] != "10" \
+                or parts[1] != str(self._ip_base):
+            return 0
+        try:
+            hi, lo = int(parts[2]), int(parts[3])
+        except ValueError:
+            return 0
+        return hi * 254 + (lo - 1)
+
     async def _allocate_devices(self, key: str, pod: dict) -> bool:
         """Kubelet-side DRA Allocate: record the scheduler's persisted
         per-claim device allocation in the local ledger."""
@@ -267,8 +290,33 @@ class NodeAgent:
                 # PreBind persists the allocation before binding, so this
                 # is transient at worst; the pod re-syncs on claim update.
                 return False
-            self.ledger.allocate(key, ref.get("name") or claim_name,
-                                 list(alloc.get("devices") or []))
+            devices = list(alloc.get("devices") or [])
+            cname = ref.get("name") or claim_name
+            try:
+                self.ledger.allocate(key, cname, devices)
+            except ValueError:
+                # Device clash = OUR ledger is stale (a departed pod's
+                # checkpoint entry survived): reconcile against the live
+                # bound-pod set and retry once; a second clash is a real
+                # double-allocation and the pod must stay Pending,
+                # VISIBLY, until the conflicting claim resolves.
+                try:
+                    lst = await self.store.list(
+                        "pods", fields={"spec.nodeName": self.node_name})
+                except StoreError:
+                    return False
+                gone = self.ledger.reconcile(
+                    {namespaced_name(p) for p in lst.items})
+                try:
+                    self.ledger.allocate(key, cname, devices)
+                except ValueError:
+                    logger.warning(
+                        "agent %s: pod %s claim %s devices %s still "
+                        "clash after reconcile (%d stale entries "
+                        "dropped); leaving Pending until the claim "
+                        "resolves", self.node_name, key, cname, devices,
+                        len(gone))
+                    return False
         return True
 
     async def _mark_running(self, key: str, pod: dict) -> None:

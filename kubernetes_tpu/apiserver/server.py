@@ -5,9 +5,11 @@ Parity targets:
   → the aiohttp middleware stack (recovery → request-info → authn →
   priority-and-fairness → audit), in the reference's order.
 - `pkg/endpoints/handlers/{create,get,watch,rest}.go` → the resource routes.
-- `pkg/util/flowcontrol` (APF) → `PriorityLevel` fair-queued seats: per-flow
-  FIFO queues drained round-robin into a bounded seat pool, 429 + Retry-After
-  on queue overflow (shuffle-shard omitted; flow = user-agent).
+- `pkg/util/flowcontrol` (APF) → `PriorityLevel` fair-queued seats with
+  SHUFFLE SHARDING (see `PriorityLevel` below): each flow (User-Agent) is
+  dealt a deterministic hand of candidate queues and enqueues on the
+  shortest; queues drain round-robin into a bounded seat pool, 429 +
+  Retry-After on queue overflow.
 - `pkg/registry/core/pod/storage/storage.go BindingREST.Create` → the
   pods/binding subresource route.
 - watch wire: newline-delimited JSON WatchEvents with BOOKMARK frames and

@@ -39,9 +39,13 @@ class EventRecorder:
         self._pending: list[dict] = []
         self._draining = False
         self.dropped = 0
+        #: every event() call, dropped or not — dropped/emitted is the
+        #: drop RATE consumers (the perf harness detail JSON) report.
+        self.emitted = 0
 
     def event(self, obj: Mapping, event_type: str, reason: str, message: str) -> None:
         """Fire-and-forget, like the reference's buffered broadcaster."""
+        self.emitted += 1
         if len(self._pending) >= self.MAX_PENDING:
             self.dropped += 1
             if self.dropped % 1000 == 1:

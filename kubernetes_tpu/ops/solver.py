@@ -264,6 +264,114 @@ def gang_filter(assign, gang_onehot, gang_required):
     return jnp.where(keep, assign, -1)
 
 
+#: int32 "no victim" priority padding — mirrors _WaveState.INF (int64 there;
+#: the device scan runs int32, and k8s priorities are int32 by API).
+PRIO_INF = jnp.int32(2**31 - 1)
+
+
+@jax.jit
+def propose_victims(req_q, prio, banned, used, alloc, pods_used, pods_alloc,
+                    vreq, vprio, offsets):
+    """Batched preemption victim proposal (SURVEY §7 phase 6,
+    "solve-with-victim-relaxation"): ONE device program proposes, for every
+    failed preemptor in a wave, the reference-cost-minimal (node, victim
+    count) — replacing the per-preemptor host candidate search.
+
+    Per node, victims are the priority-ASCENDING resident prefix (the same
+    ordering `DefaultPreemption._WaveState` builds), so "evict the first k"
+    is always the cheapest k-victim set and prefix feasibility is a
+    relaxed-capacity check. The scan threads claims through per-node state
+    exactly like the capacity carry in `greedy_assign`: a chosen node's
+    victim prefix is consumed (shifted out) and the preemptor's load is
+    charged, so concurrent preemptors spread instead of stacking — the
+    in-wave accounting `_WaveState.claim` does, but without P host
+    round-trips.
+
+    req_q:    (P, R) int32 preemptor requests, wave order (priority desc)
+    prio:     (P,)   int32 preemptor priorities
+    banned:   (P, N) bool  — UnschedulableAndUnresolvable nodes per preemptor
+    used/alloc:        (N, R) int32 node requested/allocatable
+    pods_used/alloc:   (N,)   int32
+    vreq:     (N, K, R) int32 per-victim requests (ascending priority; 0 pad)
+    vprio:    (N, K)    int32 per-victim priorities (PRIO_INF pad)
+    offsets:  (P,) int32 per-preemptor rotation for the equal-cost tiebreak
+        (the host path's seeded tie shuffle, made deterministic: ties pick
+        the node minimizing (index - offset) mod N, so a wave's preemptors
+        spread across an equal-cost set instead of all hitting node 0)
+
+    Returns (node (P,) int32 [-1 = no candidate], count (P,) int32,
+    used', pods_used', vreq', vprio') — the post-claim carry, so a caller
+    chunking a wave wider than one P bucket threads state across calls
+    without re-uploading (same pattern as the packed used-state chain).
+
+    Cost ordering per the reference's pickOneNodeForPreemption subset the
+    host path implements: lowest max victim priority → smallest priority
+    sum → fewest victims (PDB tier absent there too). Proposals are
+    host-verified against the live snapshot (full Filter chain) before any
+    eviction — this program only replaces the SEARCH.
+    """
+    N, K, R = vreq.shape
+    iota_n = jnp.arange(N, dtype=jnp.int32)
+    karange = jnp.arange(K, dtype=jnp.int32)
+    BIG = jnp.int32(2**31 - 1)
+
+    def step(carry, inp):
+        used, pods_used, vreq, vprio = carry
+        q, p, ban, off = inp
+        valid = vprio < PRIO_INF                            # (N, K)
+        rel = jnp.cumsum(vreq, axis=1)                      # (N, K, R)
+        prio_m = jnp.where(valid, vprio, 0)
+        # Priority SUM rides float32: an int32 cumsum of near-INT32_MAX
+        # priorities over a deep prefix overflows. Exact below 2^24;
+        # above, the sum key coarsens ties only — candidates are
+        # host-verified before any eviction either way.
+        vsum = jnp.cumsum(prio_m.astype(jnp.float32), axis=1)
+        vmax = lax.cummax(prio_m, axis=1)                   # (N, K)
+        # Ascending sort ⇒ vprio[k] < p implies the whole prefix is
+        # below the preemptor (same invariant the host candidates() uses).
+        eligible = vprio < p
+        fits = jnp.all(used[:, None, :] - rel + q[None, None, :]
+                       <= alloc[:, None, :], axis=-1)
+        fits = fits & (pods_used[:, None] - karange[None, :]
+                       <= pods_alloc[:, None])
+        ok = eligible & fits                                # (N, K)
+        any_ok = jnp.any(ok, axis=1) & jnp.logical_not(ban)
+        kmin = jnp.argmax(ok, axis=1).astype(jnp.int32)     # first fit
+        cmax = jnp.take_along_axis(vmax, kmin[:, None], 1)[:, 0]
+        csum = jnp.take_along_axis(vsum, kmin[:, None], 1)[:, 0]
+        # Staged lexicographic argmin (vmax, vsum, count), rotation tiebreak.
+        k1 = jnp.where(any_ok, cmax, BIG)
+        c1 = any_ok & (cmax == jnp.min(k1))
+        k2 = jnp.where(c1, csum, jnp.float32(jnp.inf))
+        c2 = c1 & (csum == jnp.min(k2))
+        k3 = jnp.where(c2, kmin, BIG)
+        c3 = c2 & (kmin == jnp.min(k3))
+        rot = (iota_n - off) % N
+        n_star = jnp.argmin(jnp.where(c3, rot, BIG)).astype(jnp.int32)
+        found = jnp.any(any_ok)
+        count = kmin[n_star] + 1
+        # Claim: drop the chosen prefix, charge the preemptor, shift the
+        # node's victim arrays so later wave members see the truth.
+        hit = (iota_n == n_star) & found
+        freed = rel[n_star, count - 1]                      # (R,)
+        used = used + jnp.where(hit[:, None], q[None, :] - freed[None, :], 0)
+        pods_used = pods_used + jnp.where(hit, 1 - count, 0)
+        src = jnp.clip(karange + count, 0, K - 1)
+        keep = (karange + count) < K
+        row_vreq = jnp.where(keep[:, None], vreq[n_star][src], 0)
+        row_vprio = jnp.where(keep, vprio[n_star][src], PRIO_INF)
+        vreq = jnp.where(hit[:, None, None], row_vreq[None, :, :], vreq)
+        vprio = jnp.where(hit[:, None], row_vprio[None, :], vprio)
+        out = (jnp.where(found, n_star, jnp.int32(-1)),
+               jnp.where(found, count, jnp.int32(0)))
+        return (used, pods_used, vreq, vprio), out
+
+    carry, (node, count) = lax.scan(
+        step, (used, pods_used, vreq, vprio),
+        (req_q, prio, banned, offsets))
+    return (node, count) + carry
+
+
 @jax.jit
 def fragmentation(free_q, alloc_q, valid):
     """Node fragmentation %: mean over non-empty resource columns of the

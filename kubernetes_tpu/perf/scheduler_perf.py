@@ -70,6 +70,11 @@ class WorkloadResult:
         self.fragmentation_pct = 0.0
         self.scheduled_total = 0
         self.unschedulable_total = 0
+        #: DropIfChannelFull accounting (bounded event broadcaster): a
+        #: burst silently shedding most of its "Scheduled" events is a
+        #: result property, not stderr noise.
+        self.events_emitted_total = 0
+        self.events_dropped_total = 0
 
     def as_dict(self) -> dict:
         import math
@@ -87,6 +92,11 @@ class WorkloadResult:
             "fragmentation_pct": round(self.fragmentation_pct, 2),
             "scheduled_total": self.scheduled_total,
             "unschedulable_total": self.unschedulable_total,
+            "events_dropped_total": self.events_dropped_total,
+            "events_dropped_pct": round(
+                100.0 * self.events_dropped_total
+                / self.events_emitted_total, 2)
+            if self.events_emitted_total else 0.0,
         }
 
 
@@ -320,6 +330,15 @@ class PerfRunner:
                                 for name in names[lo:lo + 512]))
                     pod_seq += count
                     created_total += count
+                    if op.get("scopedBarrier") and not measured:
+                        # Wait for THIS op's pods only (reference barriers
+                        # take a labelSelector): lets a warmup op complete
+                        # even when it deletes other pods (a preemption
+                        # warmup shrinks the global bound count, so a
+                        # global barrier would never pass).
+                        pod_ns = tmpl.get("namespace", "default")
+                        want = {f"{pod_ns}/{n}" for n in names}
+                        await self._wait_keys(bound_keys, want, deadline)
                     if measured:
                         # Scoped to THIS op's pods (reference barriers take
                         # a labelSelector for the same reason): preemption
@@ -407,6 +426,8 @@ class PerfRunner:
         result.scheduled_total = _result_count(metrics, "scheduled")
         result.unschedulable_total = _result_count(metrics, "unschedulable")
         result.fragmentation_pct = self._fragmentation(sched)
+        result.events_emitted_total = sched.recorder.emitted
+        result.events_dropped_total = sched.recorder.dropped
         return result
 
     @staticmethod
@@ -502,9 +523,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("config", help="workload YAML")
     ap.add_argument("--backend", choices=["host", "tpu"], default="host")
     ap.add_argument("--batch-size", type=int, default=1)
-    ap.add_argument("--chunk", type=int, default=1024,
-                    help="backend solve chunk (jit batch signature); "
-                         "dirty-mask/score families favor smaller chunks")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="OVERRIDE the backend solve chunk (jit batch "
+                         "signature); default lets the adaptive tuner "
+                         "choose per measured latency/dirty ratio")
     ap.add_argument("--filter", default=None)
     args = ap.parse_args(argv)
 
@@ -513,7 +535,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.backend == "tpu":
         from kubernetes_tpu.ops import TPUBackend
         batch = max(batch, 128)
-        chunk = max(min(args.chunk, batch), 2)
+        chunk = None if args.chunk is None \
+            else max(min(args.chunk, batch), 2)
         factory = lambda: TPUBackend(max_batch=chunk)  # noqa: E731
     results = run_suite(load_config(args.config), backend_factory=factory,
                         batch_size=batch, filter_name=args.filter)

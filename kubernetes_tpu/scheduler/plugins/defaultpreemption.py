@@ -51,9 +51,9 @@ class _WaveState:
     - used[n, r] / alloc[n, r], pods_used/alloc[n]
     """
 
-    __slots__ = ("nodes", "resources", "r_index", "rel", "vprio", "vsum",
-                 "vmax", "vcount", "used", "alloc", "pods_used",
-                 "pods_alloc", "victims", "generation")
+    __slots__ = ("nodes", "resources", "r_index", "rel", "vreq", "vprio",
+                 "vsum", "vmax", "vcount", "used", "alloc", "pods_used",
+                 "pods_alloc", "victims", "generation", "names_hash")
 
     INF = np.iinfo(np.int64).max
 
@@ -62,6 +62,9 @@ class _WaveState:
         nodes = list(snapshot.nodes)
         self.nodes = nodes
         self.generation = getattr(snapshot, "generation", None)
+        #: node-order fingerprint: DiagMap.banned_mask rows from the
+        #: solve-time snapshot only apply when the order still matches.
+        self.names_hash = hash(tuple(ni.name for ni in nodes))
         res: dict[str, None] = {}
         for ni in nodes:
             for r in ni.allocatable.res:
@@ -79,6 +82,10 @@ class _WaveState:
             kmax = max(kmax, len(cand))
         self.victims = per_node
         self.rel = np.zeros((N, kmax, R), dtype=np.int64)
+        #: per-victim request vectors (rel is their prefix sum) — the
+        #: device proposal scan re-derives prefixes after in-scan claims,
+        #: which needs the per-victim granularity.
+        self.vreq = np.zeros((N, kmax, R), dtype=np.int64)
         self.vprio = np.full((N, kmax), self.INF, dtype=np.int64)
         self.vsum = np.zeros((N, kmax), dtype=np.int64)
         self.vmax = np.zeros((N, kmax), dtype=np.int64)
@@ -113,6 +120,7 @@ class _WaveState:
                     j = self.r_index.get(r)
                     if j is not None:
                         acc[j] += v
+                        self.vreq[n, k, j] = v
                 psum += p.priority
                 pmax = max(pmax, p.priority)
                 self.rel[n, k] = acc
@@ -178,6 +186,7 @@ class _WaveState:
                 self.used[n, j] += val
         self.pods_used[n] += 1 - count
         self.rel[n] = 0
+        self.vreq[n] = 0
         self.vprio[n] = self.INF
         self.vsum[n] = 0
         self.vmax[n] = 0
@@ -190,6 +199,7 @@ class _WaveState:
                 j = self.r_index.get(r)
                 if j is not None:
                     acc[j] += val
+                    self.vreq[n, k, j] = val
             psum += p.priority
             pmax = max(pmax, p.priority)
             self.rel[n, k] = acc
@@ -225,19 +235,204 @@ class DefaultPreemption(Plugin):
         #: it re-nominates elsewhere, or on TTL (pod deleted pre-bind).
         self._promised: dict[str, dict[str, tuple]] = {}
         self._promised_pods: dict[str, str] = {}  # pod key -> node name
+        #: pod key -> victim keys evicted for it — while any is still
+        #: resident on the promised node, a retry re-nominates the same
+        #: node WITHOUT a second eviction (preemption.go
+        #: PodEligibleToPreemptOthers: a preemptor whose victims are still
+        #: terminating must not preempt again).
+        self._promised_victims: dict[str, list[str]] = {}
+        #: device-proposed (wave, node, count) per pod key — see prime_wave.
+        self._primed: dict[str, tuple] = {}
+
+    def _in_flight_node(self, pod: PodInfo, snapshot: Snapshot) -> str | None:
+        """The node already promised to this pod, if its eviction is still
+        in flight (some claimed victim remains resident there). Retries
+        re-nominate it instead of evicting a second set of victims."""
+        node = self._promised_pods.get(pod.key)
+        if node is None:
+            return None
+        vkeys = self._promised_victims.get(pod.key)
+        if not vkeys:
+            return None
+        ni = snapshot.get(node)
+        if ni is None:
+            return None
+        resident = {p.key for p in ni.pods}
+        return node if any(vk in resident for vk in vkeys) else None
+
+    def prime_wave(self, pods: list[PodInfo], snapshot: Snapshot,
+                   statuses_by_pod: Mapping[str, Mapping[str, Status]]
+                   ) -> None:
+        """Batched device victim proposal for a failure wave (SURVEY §7
+        phase 6): ONE `solver.propose_victims` call ranks a candidate per
+        (preemptor, node) for every resolvable failed pod, threading
+        in-wave claims on device. `post_filter` then verifies each primed
+        proposal against the live snapshot with the full Filter chain and
+        evicts exactly as before — only the SEARCH moved off host.
+
+        Proposals assume claims land in wave order; a host-verify
+        divergence (stale wave, non-resource filter) drops to the ranked
+        host search for that pod, and every later proposal is still
+        individually verified before use."""
+        self._primed.clear()
+        if self.framework is None or not pods:
+            return
+        wave = self._wave_state(snapshot)
+        name_to_idx = {ni.name: n for n, ni in enumerate(wave.nodes)}
+        elig: list[PodInfo] = []
+        banned_rows: list[np.ndarray] = []
+        N = len(wave.nodes)
+        for pi in pods:
+            if self._in_flight_node(pi, snapshot) is not None:
+                continue  # the guard answers without a new eviction
+            statuses = statuses_by_pod.get(pi.key) or {}
+            # DiagMap (the batched backend's diagnostics) precomputes both
+            # aggregates; plain dicts take the O(N) scan.
+            bm = getattr(statuses, "banned_mask", None)
+            if bm is not None and \
+                    statuses.banned_nodes_hash == wave.names_hash:
+                if not statuses.resolvable:
+                    continue
+                ban = bm
+            else:
+                ban = np.zeros((N,), dtype=bool)
+                resolvable = not statuses
+                for name, st in statuses.items():
+                    if st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                        j = name_to_idx.get(name)
+                        if j is not None:
+                            ban[j] = True
+                    else:
+                        resolvable = True
+                if not resolvable:
+                    continue  # _handle_failure won't run PostFilter on it
+            elig.append(pi)
+            banned_rows.append(ban)
+        if not elig:
+            return
+        from kubernetes_tpu.ops import solver
+        import jax.numpy as jnp
+        R = wave.rel.shape[2]
+        # FIXED preemptor bucket + power-of-two victim-prefix padding:
+        # wave widths vary per batch, and an exact-shape jit signature
+        # would recompile the scan per distinct width. Waves wider than
+        # the bucket run in chunks, threading the post-claim device carry
+        # (the scan state IS the claim ledger). Padding rows carry
+        # INT32_MIN priority + all-banned, so they propose nothing.
+        P = len(elig)
+        PB = self.WAVE_DEVICE_BUCKET
+        K = wave.vreq.shape[1]
+        K2 = max(8, 1 << (K - 1).bit_length())
+        cap = 2**31 - 1
+        req64 = np.zeros((P, R), dtype=np.int64)
+        prio = np.zeros((P,), dtype=np.int32)
+        for i, pi in enumerate(elig):
+            for r, v in pi.requests.items():
+                j = wave.r_index.get(r)
+                if j is not None:
+                    req64[i, j] = v
+            prio[i] = min(pi.priority, cap - 1)
+        banned = np.stack(banned_rows)
+        # Conservative per-column power-of-two quantization: byte
+        # quantities (memory, ephemeral-storage) overflow int32, and the
+        # scan cumsums released resources — so scale each column until
+        # its max fits 2^30 (headroom for the in-scan sums). Rounding
+        # direction is one-sided: consumption (used, preemptor request)
+        # rounds UP, supply (alloc, released victim resources) rounds
+        # DOWN, so a scaled "fits" always implies a true fit; the rare
+        # false reject only costs a fallback to the ranked host search.
+        lim = np.int64(1 << 30)
+        colmax = np.maximum(wave.alloc.max(axis=0, initial=0),
+                            wave.used.max(axis=0, initial=0))
+        colmax = np.maximum(colmax, req64.max(axis=0, initial=0))
+        shift = np.zeros((R,), dtype=np.int64)
+        over = colmax > lim
+        if over.any():
+            shift[over] = np.ceil(
+                np.log2(colmax[over] / lim)).astype(np.int64)
+
+        def up(a):  # consumption: ceil
+            return ((a + (np.int64(1) << shift) - 1) >> shift).astype(
+                np.int32)
+
+        def down(a):  # supply: floor
+            return (a >> shift).astype(np.int32)
+
+        req = up(req64)
+        vreq = np.zeros((N, K2, R), dtype=np.int32)
+        vreq[:, :K] = down(wave.vreq)
+        vprio = np.full((N, K2), cap, dtype=np.int32)
+        vprio[:, :K] = np.minimum(wave.vprio, cap)
+        carry = (jnp.asarray(up(wave.used)),
+                 jnp.asarray(down(wave.alloc)),
+                 jnp.asarray(wave.pods_used.astype(np.int32)),
+                 jnp.asarray(wave.pods_alloc.astype(np.int32)),
+                 jnp.asarray(vreq), jnp.asarray(vprio))
+        used_d, alloc_d, pused_d, palloc_d, vreq_d, vprio_d = carry
+        nodes_out = np.empty((P,), dtype=np.int32)
+        counts_out = np.empty((P,), dtype=np.int32)
+        for lo in range(0, P, PB):
+            hi = min(lo + PB, P)
+            w = hi - lo
+            req_c = np.zeros((PB, R), dtype=np.int32)
+            req_c[:w] = req[lo:hi]
+            prio_c = np.full((PB,), -2**31, dtype=np.int32)
+            prio_c[:w] = prio[lo:hi]
+            ban_c = np.ones((PB, N), dtype=bool)
+            ban_c[:w] = banned[lo:hi]
+            offsets = np.fromiter(
+                (self._rng.randrange(N) for _ in range(PB)),
+                dtype=np.int32, count=PB)
+            node, count, used_d, pused_d, vreq_d, vprio_d = \
+                solver.propose_victims(
+                    jnp.asarray(req_c), jnp.asarray(prio_c),
+                    jnp.asarray(ban_c), used_d, alloc_d, pused_d,
+                    palloc_d, vreq_d, vprio_d, jnp.asarray(offsets))
+            nodes_out[lo:hi] = np.asarray(node)[:w]
+            counts_out[lo:hi] = np.asarray(count)[:w]
+        for i, pi in enumerate(elig):
+            if nodes_out[i] >= 0:
+                self._primed[pi.key] = (N, int(nodes_out[i]),
+                                        int(counts_out[i]))
 
     def post_filter(self, state: CycleState, pod: PodInfo, snapshot: Snapshot,
                     filtered_status: Mapping[str, Status]) -> tuple[str, Status]:
         if self.framework is None:
             return "", Status.unschedulable()
+        in_flight = self._in_flight_node(pod, snapshot)
+        if in_flight is not None:
+            return in_flight, Status.success()
         wave = self._wave_state(snapshot)
+        # Device-primed proposal (prime_wave): verify + commit without the
+        # ranked host search. Validation is SEMANTIC, not wave-identity —
+        # the wave resync budget (WAVE_MAX_CLAIMS/AGE) rebuilds mid-wave,
+        # and a rebuilt wave's minimal prefix on the proposed node is still
+        # a valid (claimed-victim-free) choice; the full live-filter verify
+        # in _verify_and_commit guards feasibility either way. Primes that
+        # no longer have an eligible prefix fall to the ranked path below.
+        primed = self._primed.pop(pod.key, None)
+        if primed is not None and primed[0] == len(wave.nodes):
+            n, count = primed[1], primed[2]
+            if count <= len(wave.victims[n]) and all(
+                    v.priority < pod.priority
+                    for v in wave.victims[n][:count]):
+                committed = self._verify_and_commit(
+                    state, pod, snapshot, wave, n, count)
+                if committed is not None:
+                    return committed, Status.success()
         banned: set[int] = set()
         # Nodes rejected as UnschedulableAndUnresolvable can't be helped by
         # preemption (preemption.go `nodesWherePreemptionMightHelp`).
-        for n, ni in enumerate(wave.nodes):
-            st = filtered_status.get(ni.name)
-            if st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
-                banned.add(n)
+        bm = getattr(filtered_status, "banned_mask", None)
+        if bm is not None and \
+                filtered_status.banned_nodes_hash == wave.names_hash:
+            banned = set(np.nonzero(bm)[0])
+        else:
+            for n, ni in enumerate(wave.nodes):
+                st = filtered_status.get(ni.name)
+                if st is not None and \
+                        st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                    banned.add(n)
         ranked = wave.candidates(pod, banned)
         # Seeded tie shuffle among equal-cost leaders (the reference scans
         # a Go map whose iteration order is randomized, which spreads
@@ -259,26 +454,36 @@ class DefaultPreemption(Plugin):
         for attempt, (n, count) in enumerate(ranked):
             if attempt >= 8:
                 break
-            ni = wave.nodes[n]
-            victims = wave.victims[n][:count]
-            # Verify against the LIVE node (the wave may be a bounded-age
-            # batch view): stale-wave mis-rankings fail here and fall to
-            # the next-best candidate.
-            live_ni = snapshot.get(ni.name) or ni
-            dry = live_ni.clone()
-            for v in victims:
-                dry.remove_pod(v.key)
-            if self.framework.run_filters(
-                    state.clone(), pod, dry).is_success():
-                self._drop_promise(pod.key)  # re-nomination moves the charge
-                chosen = wave.claim(n, count, pod, self._claimed,
-                                    self._promised)
-                self._promised_pods[pod.key] = ni.name
-                self._wave_claims += 1
-                if self.evict is not None:
-                    self.evict(pod, [v.key for v in chosen], ni.name)
-                return ni.name, Status.success()
+            committed = self._verify_and_commit(
+                state, pod, snapshot, wave, n, count)
+            if committed is not None:
+                return committed, Status.success()
         return self._post_filter_scan(state, pod, snapshot, filtered_status)
+
+    def _verify_and_commit(self, state: CycleState, pod: PodInfo,
+                           snapshot: Snapshot, wave: _WaveState,
+                           n: int, count: int) -> str | None:
+        """Verify one (node, victim count) candidate against the LIVE node
+        with the full Filter chain (the wave may be a bounded-age batch
+        view); on success, claim in the wave ledger and evict. Returns the
+        node name, or None on divergence."""
+        ni = wave.nodes[n]
+        victims = wave.victims[n][:count]
+        live_ni = snapshot.get(ni.name) or ni
+        dry = live_ni.clone()
+        for v in victims:
+            dry.remove_pod(v.key)
+        if not self.framework.run_filters(
+                state.clone(), pod, dry).is_success():
+            return None
+        self._drop_promise(pod.key)  # re-nomination moves the charge
+        chosen = wave.claim(n, count, pod, self._claimed, self._promised)
+        self._promised_pods[pod.key] = ni.name
+        self._promised_victims[pod.key] = [v.key for v in chosen]
+        self._wave_claims += 1
+        if self.evict is not None:
+            self.evict(pod, [v.key for v in chosen], ni.name)
+        return ni.name
 
     @staticmethod
     def _cost_of(wave: _WaveState, entry: tuple[int, int]):
@@ -286,14 +491,22 @@ class DefaultPreemption(Plugin):
         return (int(wave.vmax[n, count - 1]), int(wave.vsum[n, count - 1]),
                 count)
 
+    #: fixed preemptor-axis width of one propose_victims call: one jit
+    #: signature regardless of wave width (wider waves chunk + thread the
+    #: device carry; narrower ones pad with inert rows).
+    WAVE_DEVICE_BUCKET = 128
     #: resync budget: rebuild from the live snapshot after this many
-    #: claims or this much wall time, whichever first.
-    WAVE_MAX_CLAIMS = 128
+    #: claims or this much wall time, whichever first. Claims are exact
+    #: in-wave (in-place ledger) and every candidate is live-verified
+    #: before eviction, so the budget only bounds cost-ranking staleness;
+    #: 512 lets a 1k-preemptor wave run with ~2 rebuilds instead of 8.
+    WAVE_MAX_CLAIMS = 512
     WAVE_MAX_AGE_S = 0.5
     #: a nominated preemptor that never binds stops being charged.
     PROMISE_TTL_S = 30.0
 
     def _drop_promise(self, pod_key: str) -> None:
+        self._promised_victims.pop(pod_key, None)
         node = self._promised_pods.pop(pod_key, None)
         if node is not None:
             entries = self._promised.get(node)
@@ -327,6 +540,7 @@ class DefaultPreemption(Plugin):
                 if pk in resident or now - ts > self.PROMISE_TTL_S:
                     entries.pop(pk, None)
                     self._promised_pods.pop(pk, None)
+                    self._promised_victims.pop(pk, None)
             if not entries:
                 self._promised.pop(node, None)
         wave = _WaveState(snapshot, self._claimed, self._promised)
@@ -360,6 +574,7 @@ class DefaultPreemption(Plugin):
         self._promised.setdefault(node_name, {})[pod.key] = (
             dict(pod.requests), time.monotonic())
         self._promised_pods[pod.key] = node_name
+        self._promised_victims[pod.key] = [v.key for v in victims]
         self._wave = None
         if self.evict is not None:
             self.evict(pod, [v.key for v in victims], node_name)

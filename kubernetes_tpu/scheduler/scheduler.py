@@ -54,10 +54,14 @@ class FitError(Exception):
         self.pod = pod
         self.num_nodes = num_nodes
         self.statuses = statuses
-        reasons: dict[str, int] = {}
-        for st in statuses.values():
-            for r in st.reasons:
-                reasons[r] = reasons.get(r, 0) + 1
+        # The batched backend's DiagMap precomputes the counts (re-counting
+        # N-entry maps per failed pod dominated dense failure waves).
+        reasons = getattr(statuses, "reason_counts", None)
+        if reasons is None:
+            reasons = {}
+            for st in statuses.values():
+                for r in st.reasons:
+                    reasons[r] = reasons.get(r, 0) + 1
         msg = ", ".join(f"{n} {r}" for r, n in sorted(reasons.items()))
         super().__init__(
             f"0/{num_nodes} nodes are available: {msg}" if msg
@@ -133,6 +137,11 @@ class Scheduler:
         self._binding_tasks: set[asyncio.Task] = set()
         self._permit_waiters: dict[str, asyncio.Future] = {}
         self._stop = False
+        #: consecutive nominee-check failures per preemptor retry: the
+        #: first few failures requeue cheaply (victim deletes are still
+        #: landing); persistent failure falls to the full batch path,
+        #: which can re-preempt (the nominee may have been stolen).
+        self._nominee_fails: dict[str, int] = {}
         #: tick-coalesced cluster events (label-deduped) for ONE
         #: move_all_batch scan per loop tick — see _move_all_soon.
         self._pending_moves: dict[str, ClusterEvent] = {}
@@ -206,6 +215,7 @@ class Scheduler:
             key = namespaced_name(obj)
             if obj.get("spec", {}).get("nodeName") or self.cache.is_assumed(key):
                 self.cache.remove_pod(key)
+            self._nominee_fails.pop(key, None)
             asyncio.ensure_future(self.queue.delete(key))
             self._move_all_soon(ClusterEvent("Pod", "Delete"))
 
@@ -419,20 +429,54 @@ class Scheduler:
             # plugin set/weights (profiles are keyed by schedulerName), and
             # the TPUScorer gate selects the backend PER PROFILE
             # (backend_profiles; None = all).
-            # Preemptor retries ride the host path's nominated-node fast
-            # path FIRST, across every profile (schedule_one.go evaluates
-            # the nominee before anything else): the batch solve has no
-            # nominee bias, so any batch processed earlier could steal the
-            # freed node and force a re-preemption — eviction churn.
+            # Preemptor retries ride a nominated-node fast check FIRST,
+            # across every profile (schedule_one.go evaluates the nominee
+            # before anything else): the batch solve has no nominee bias,
+            # so any batch processed earlier could steal the freed node
+            # and force a re-preemption — eviction churn. The check is
+            # nominee-ONLY: a preemptor whose nominee is not yet feasible
+            # (victims still terminating) REJOINS the batch instead of
+            # burning a full per-pod host scan — per-retry O(N·plugins)
+            # scans were the dominant cost of 1k-preemptor waves
+            # (BASELINE.md r6), and the failure wave's preemption guard
+            # re-nominates without re-evicting.
             nominated = [pi for pi in pods if pi.nominated_node]
+            rejoin: set[str] = set()
             if nominated:
+                placed = 0
                 for pi in nominated:
-                    await self._schedule_host_path(pi, snapshot)
-                    snapshot = self.cache.update_snapshot()
-                tr.step(f"nominated fast path ({len(nominated)} pods)")
+                    if await self._try_nominated(pi, snapshot):
+                        snapshot = self.cache.update_snapshot()
+                        self._nominee_fails.pop(pi.key, None)
+                        placed += 1
+                        continue
+                    fails = self._nominee_fails.get(pi.key, 0) + 1
+                    # Waiting is only right while victim deletes are
+                    # still in flight — i.e. the nominee still hosts
+                    # lower-priority pods whose Delete events will
+                    # re-activate us. A nominee with none left was
+                    # STOLEN by equal/higher-priority pods: no event is
+                    # coming, so go re-preempt now instead of idling
+                    # until the unschedulable flush.
+                    ni = snapshot.get(pi.nominated_node)
+                    victims_pending = ni is not None and any(
+                        p.priority < pi.priority for p in ni.pods)
+                    if fails >= 3 or not victims_pending:
+                        # Full batch path, which can re-preempt.
+                        self._nominee_fails.pop(pi.key, None)
+                        rejoin.add(pi.key)
+                    else:
+                        # Victim deletes are still landing: requeue and
+                        # let their Delete events re-activate the pod —
+                        # a full solve for a not-yet-free nominee is the
+                        # wave's dominant retry cost.
+                        self._nominee_fails[pi.key] = fails
+                        await self.queue.add_unschedulable(pi)
+                tr.step(
+                    f"nominated fast path ({placed}/{len(nominated)} pods)")
             by_profile: dict[str, list[PodInfo]] = {}
             for pi in pods:
-                if pi.nominated_node:
+                if pi.nominated_node and pi.key not in rejoin:
                     continue
                 by_profile.setdefault(pi.scheduler_name, []).append(pi)
             # The backend chunks to its own batch capacity internally and
@@ -455,6 +499,46 @@ class Scheduler:
             # Re-snapshot so pods later in the batch see earlier assumes.
             snapshot = self.cache.update_snapshot()
         tr.step(f"host path ({len(pods)} pods)")
+
+    async def _try_nominated(self, pi: PodInfo, snapshot) -> bool:
+        """Nominee-only evaluation of a preemptor retry: PreFilter + Filter
+        on the nominated node alone. True = assumed and binding. False =
+        nominee not (yet) feasible; the caller batches the pod instead of
+        scanning the rest of the cluster pod-by-pod."""
+        fwk = self.profiles.get(pi.scheduler_name)
+        if fwk is None:
+            logger.error("no profile for schedulerName=%s", pi.scheduler_name)
+            await self.queue.done(pi.key)
+            return True  # consumed; nothing else can schedule it
+        ni = snapshot.get(pi.nominated_node)
+        if ni is None:
+            return False
+        state = CycleState()
+        t0 = time.perf_counter()
+        if not fwk.run_pre_filter(state, pi, snapshot).is_success():
+            return False
+        if not fwk.run_filters(state, pi, ni).is_success():
+            return False
+        self.metrics.observe_attempt("scheduled", fwk.profile_name,
+                                     time.perf_counter() - t0)
+        await self._assume_and_bind(fwk, state, pi, ni.name)
+        return True
+
+    def _prime_preemption(self, fwk: Framework, failed: list[PodInfo],
+                          snapshot, diagnostics: Mapping) -> None:
+        """Hand the whole failure wave to preemption's batched device
+        proposal (DefaultPreemption.prime_wave) before the per-pod
+        PostFilter loop; a prime failure only loses the batching."""
+        if snapshot is None:
+            return
+        for p in fwk.post_filter_plugins:
+            prime = getattr(p, "prime_wave", None)
+            if prime is not None:
+                try:
+                    prime(failed, snapshot, diagnostics)
+                except Exception:
+                    logger.exception(
+                        "prime_wave failed; per-pod candidate search only")
 
     async def _schedule_via_backend(self, pods: list[PodInfo], snapshot) -> None:
         """Batched path: the backend returns {pod_key: node_name | None}.
@@ -519,6 +603,8 @@ class Scheduler:
             else:
                 failed.append(pi)
         live = self.cache.update_snapshot() if failed else None
+        if failed:
+            self._prime_preemption(fwk, failed, live, diagnostics)
         for pi in failed:
             self.metrics.observe_attempt("unschedulable", fwk.profile_name,
                                          elapsed / len(pods))
@@ -595,6 +681,8 @@ class Scheduler:
                 else:
                     failed.append(pi)
             live = self.cache.update_snapshot() if failed else None
+            if failed:
+                self._prime_preemption(fwk, failed, live, ctx.diagnostics)
             for pi in failed:
                 self.metrics.observe_attempt(
                     "unschedulable", fwk.profile_name, elapsed / n)
@@ -780,12 +868,15 @@ class Scheduler:
                               snapshot=None) -> None:
         """handleSchedulingFailure: record reasons, try preemption, requeue."""
         pi.last_failure = str(err)
-        pi.unschedulable_plugins = {
+        plugins = getattr(statuses, "plugins", None)
+        pi.unschedulable_plugins = plugins if plugins is not None else {
             st.plugin for st in statuses.values() if st.plugin}
         self.recorder.event(pi.pod, "Warning", "FailedScheduling", str(err))
-        resolvable = any(
-            st.code != UNSCHEDULABLE_AND_UNRESOLVABLE for st in statuses.values()
-        ) or not statuses
+        resolvable = getattr(statuses, "resolvable", None)
+        if resolvable is None:
+            resolvable = any(
+                st.code != UNSCHEDULABLE_AND_UNRESOLVABLE
+                for st in statuses.values()) or not statuses
         if resolvable and state is not None and snapshot is not None \
                 and fwk.post_filter_plugins:
             nominated, st = fwk.run_post_filters(state, pi, snapshot, statuses)

@@ -247,42 +247,39 @@ class MVCCStore:
             pass
 
     @staticmethod
-    def _select_event(ev: Event, selector: Selector | None) -> Event | None:
-        """Apply a label selector to an event, handling set transitions:
-        matched-before but not-after ⇒ synthesize DELETED; not-before but
-        after ⇒ ADDED (cacher.go dispatchEvent prevObject semantics)."""
-        if selector is None or not selector.requirements:
-            return ev
-        cur = selector.matches(ev.object.get("metadata", {}).get("labels"))
-        prev = (
-            selector.matches(ev.prev_labels)
-            if ev.prev_labels is not None
-            else (cur if ev.type != "ADDED" else False)
-        )
-        if ev.type == "DELETED":
-            return ev if (cur or prev) else None
-        if cur and not prev:
-            return Event("ADDED", ev.object, ev.rv, ev.prev_labels,
-                         ev.prev_fields)
-        if prev and not cur:
-            return Event("DELETED", ev.object, ev.rv, ev.prev_labels,
-                         ev.prev_fields)
-        return ev if cur else None
+    def _select_for(ev: Event, chan: _WatchChannel) -> Event | None:
+        """JOINT label+field selection with set-transition synthesis:
+        matched-before but not-after ⇒ DELETED; not-before but after ⇒
+        ADDED (cacher.go dispatchEvent prevObject semantics; the field
+        half is how `spec.nodeName=` watches serve kubelets — a bind looks
+        like ADDED to the node's agent).
 
-    @staticmethod
-    def _select_fields(ev: Event, fields: Mapping[str, str] | None
-                       ) -> Event | None:
-        """Field-selector twin of _select_event: enter ⇒ ADDED, leave ⇒
-        DELETED (how the reference cacher serves `spec.nodeName=` watches
-        to kubelets — a bind looks like ADDED to the node's agent)."""
-        if not fields:
+        prev/cur are each the CONJUNCTION of label-match and field-match
+        BEFORE the event type is synthesized, like the reference cacher's
+        joint predicate. Chaining one selector's synthesis into the other
+        mis-delivers opposite-direction transitions (labels enter while
+        spec.nodeName leaves in one update: joint prev and cur are both
+        non-matching, yet the chain synthesized a DELETED for an object
+        the watcher never saw)."""
+        sel = chan.selector
+        has_sel = sel is not None and sel.requirements
+        fields = chan.fields
+        if not has_sel and not fields:
             return ev
-        cur = _fields_match(fields, ev.object)
-        if ev.prev_fields is not None:
-            prev = all(ev.prev_fields.get(f, _field_value(ev.object, f)) == v
-                       for f, v in fields.items())
+        cur_l = (not has_sel) or sel.matches(
+            ev.object.get("metadata", {}).get("labels"))
+        cur_f = (not fields) or _fields_match(fields, ev.object)
+        cur = cur_l and cur_f
+        if ev.type == "ADDED":
+            prev = False
         else:
-            prev = cur if ev.type != "ADDED" else False
+            prev_l = cur_l if not has_sel or ev.prev_labels is None \
+                else sel.matches(ev.prev_labels)
+            prev_f = cur_f if not fields or ev.prev_fields is None \
+                else all(
+                    ev.prev_fields.get(f, _field_value(ev.object, f)) == v
+                    for f, v in fields.items())
+            prev = prev_l and prev_f
         if ev.type == "DELETED":
             return ev if (cur or prev) else None
         if cur and not prev:
@@ -292,12 +289,6 @@ class MVCCStore:
             return Event("DELETED", ev.object, ev.rv, ev.prev_labels,
                          ev.prev_fields)
         return ev if cur else None
-
-    def _select_for(self, ev: Event, chan: _WatchChannel) -> Event | None:
-        selected = self._select_event(ev, chan.selector)
-        if selected is None:
-            return None
-        return self._select_fields(selected, chan.fields)
 
     def _dispatch(self, resource: str, ev: Event) -> None:
         for w in self._watchers:
