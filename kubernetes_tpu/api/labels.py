@@ -97,6 +97,25 @@ def match_label_selector(sel: Mapping | None, labels: Mapping[str, str] | None) 
     return from_label_selector(sel).matches(labels)
 
 
+#: Sentinel namespace set: "every namespace". An empty namespaceSelector
+#: ({}) selects all namespaces in the reference (it matches any label set,
+#: including namespaces with no labels or no Namespace object at all), so
+#: resolution returns this instead of enumerating a namespace universe.
+#: "*" cannot collide with a real namespace (DNS-1123 forbids it).
+ALL_NAMESPACES = ("*",)
+
+
+def ns_contains(namespaces, ns: str) -> bool:
+    """Membership in a resolved namespace set, honoring ALL_NAMESPACES."""
+    return "*" in namespaces or ns in namespaces
+
+
+def is_empty_label_selector(sel: Mapping | None) -> bool:
+    """True for the match-everything selector ({} or requirement-less)."""
+    return sel is not None and not sel.get("matchLabels") \
+        and not sel.get("matchExpressions")
+
+
 def parse_selector(s: str) -> Selector:
     """Parse the string selector grammar: "a=b,c!=d,e in (x,y),f,!g".
 

@@ -200,10 +200,12 @@ class SchedulerMetrics:
             "Pods added to queues", labels=("event", "queue"))
         self.goroutines = r.gauge(
             "scheduler_goroutines", "Concurrent binding tasks", labels=("operation",))
-        #: §5.5 explainability for the TPU backend's silent fallbacks:
-        #: kind="spread_poisoned" (device spread template fell back to
-        #: host rows), kind="gang_overflow" (gangs beyond the solver's
-        #: capacity degrade to Permit-barrier-only atomicity).
+        #: §5.5 explainability for the TPU backend's degraded modes, one
+        #: increment per affected pod/gang: kind="spread_poisoned"
+        #: (spread pod missed the union scan table — steady-state zero),
+        #: kind="host_fallback" (pod took a per-pod host plugin row),
+        #: kind="gang_overflow" (gangs beyond the solver's capacity
+        #: degrade to Permit-barrier-only atomicity).
         self.backend_degradations = r.counter(
             "scheduler_tpu_backend_degradations_total",
             "TPU backend fallbacks to degraded modes", labels=("kind",))

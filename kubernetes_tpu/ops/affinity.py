@@ -18,19 +18,26 @@ template-derived workloads, so per-term cost is a (N×U) matvec — far below
 one device dispatch. The resulting (P,N) mask feeds the XLA solver; parity
 with the host plugin is differential-tested (tests/test_affinity_tensor.py).
 
-namespaceSelector terms resolve to explicit namespace sets through the
-InterPodAffinity plugin's NamespaceResolver (the reference's PreFilter
-namespace merge) — same label algebra, just a wider namespace tuple in
-the interned-count keys. Without a resolver those terms route to the
-per-pod host fallback.
+namespaceSelector terms COMPILE like everything else: the term's
+effective namespace set resolves at table-build time
+(interpodaffinity.resolve_term_namespaces) — through the plugin's
+NamespaceResolver when one is wired (the reference's PreFilter namespace
+merge), else statically ({} = ALL_NAMESPACES, non-empty selectors match
+their explicit namespaces only, exactly what an informer-less resolver
+resolves). Either way the result is just a (possibly wildcard) namespace
+tuple in the interned-count keys, so no term shape routes a pod off the
+tensor path; `supported()` is always True.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from kubernetes_tpu.api.labels import from_label_selector
+from kubernetes_tpu.api.labels import from_label_selector, ns_contains
 from kubernetes_tpu.ops.labelsets import LabelSigTable, TopologyTable
+from kubernetes_tpu.scheduler.plugins.interpodaffinity import (
+    resolve_term_namespaces as _term_ns,
+)
 from kubernetes_tpu.scheduler.types import PodInfo, Snapshot
 
 
@@ -40,18 +47,14 @@ def _seg_sum(values: np.ndarray, ids: np.ndarray, num: int) -> np.ndarray:
     return out
 
 
-def _term_ns(term: dict, owner_ns: str, resolver=None) -> tuple[str, ...]:
-    if resolver is not None and term.get("namespaceSelector") is not None:
-        return resolver(term, owner_ns)
-    return tuple(term.get("namespaces") or [owner_ns])
-
-
 class AffinityCompiler:
     """Per-snapshot compiled state for batched affinity filtering.
 
     `ns_resolver` (plugins.interpodaffinity.NamespaceResolver) resolves
-    namespaceSelector terms to explicit namespace sets; without one those
-    terms route to the host fallback (supported() returns False)."""
+    namespaceSelector terms against live Namespace labels; without one
+    the static resolution of resolve_term_namespaces applies ({} = every
+    namespace, non-empty selectors = explicit namespaces only). Every
+    term shape compiles — there is no host-fallback routing here."""
 
     def __init__(self, snapshot: Snapshot, n_pad: int, ns_resolver=None):
         self.ns_resolver = ns_resolver
@@ -81,13 +84,9 @@ class AffinityCompiler:
         # Preferred anti-affinity carriers get negative weights.
         self.resident_score: dict[
             str, tuple[np.ndarray, dict, str, bool]] = {}
-        self.score_ns_unsupported = False
 
         def _carrier(term: dict, ns: str, n: int, w: float,
                      is_hard: bool = False) -> None:
-            if term.get("namespaceSelector") and ns_resolver is None:
-                self.score_ns_unsupported = True
-                return
             key = repr((term, ns, is_hard))
             got = self.resident_score.get(key)
             if got is None:
@@ -112,6 +111,12 @@ class AffinityCompiler:
         self._count_cache: dict[str, np.ndarray] = {}
         #: per-term-signature compiled masks
         self._mask_cache: dict[str, np.ndarray] = {}
+        #: full-row caches keyed by pod CONTENT signature (namespace,
+        #: labels, term list): template-stamped batches share one row —
+        #: the per-pod O(N) row assembly was the 5k families' top host
+        #: cost. Cached rows are shared; callers must not mutate them.
+        self._filter_row_cache: dict[tuple, np.ndarray] = {}
+        self._score_row_cache: dict[tuple, np.ndarray] = {}
 
     # -- primitives --------------------------------------------------------
 
@@ -138,11 +143,9 @@ class AffinityCompiler:
     # -- per-term masks (cached by term signature) -------------------------
 
     def supported(self, pod: PodInfo) -> bool:
-        if self.ns_resolver is not None:
-            return True  # namespaceSelector terms resolve to explicit sets
-        terms = (pod.required_affinity_terms
-                 + pod.required_anti_affinity_terms)
-        return not any(t.get("namespaceSelector") for t in terms)
+        """Every term shape compiles (namespaceSelector included) —
+        retained as a seam for future exotic term shapes."""
+        return True
 
     def anti_term_mask(self, term: dict, owner_ns: str) -> np.ndarray:
         key = "anti/" + repr((term, owner_ns))
@@ -188,8 +191,9 @@ class AffinityCompiler:
             hit = self._sym_match_cache.get(mk)
             if hit is None:
                 nses = _term_ns(term, owner_ns, self.ns_resolver)
-                hit = pod.namespace in nses and from_label_selector(
-                    term.get("labelSelector")).matches(pod.labels)
+                hit = ns_contains(nses, pod.namespace) and \
+                    from_label_selector(
+                        term.get("labelSelector")).matches(pod.labels)
                 self._sym_match_cache[mk] = hit
             if not hit:
                 continue
@@ -207,7 +211,15 @@ class AffinityCompiler:
 
     def filter_row(self, pod: PodInfo) -> np.ndarray:
         """(n_pad,) bool feasibility row for one pending pod — exact
-        InterPodAffinity.Filter semantics over the snapshot."""
+        InterPodAffinity.Filter semantics over the snapshot. Cached by
+        pod CONTENT signature (template batches share one row); the
+        returned array is shared — do not mutate."""
+        ck = (pod.namespace, tuple(sorted(pod.labels.items())),
+              repr(pod.required_affinity_terms),
+              repr(pod.required_anti_affinity_terms))
+        cached = self._filter_row_cache.get(ck)
+        if cached is not None:
+            return cached
         row = self.symmetry_mask(pod).copy()
         for term in pod.required_anti_affinity_terms:
             row &= self.anti_term_mask(term, pod.namespace)
@@ -226,20 +238,13 @@ class AffinityCompiler:
                 for per_node, has_key, _ in presences:
                     row &= has_key & (per_node > 0)
         row[self.n_real:] = False
+        self._filter_row_cache[ck] = row
         return row
 
     def score_supported(self, pod: PodInfo) -> bool:
-        """Without a namespace resolver, namespaceSelector terms need
-        per-namespace label matching the interned tables don't model —
-        those pods take the host score path."""
-        if self.ns_resolver is not None:
-            return True
-        if self.score_ns_unsupported:
-            return False
-        return not any(
-            (t.get("podAffinityTerm") or {}).get("namespaceSelector")
-            for t in (pod.preferred_affinity_terms
-                      + pod.preferred_anti_affinity_terms))
+        """Preferred terms compile like required ones (namespaceSelector
+        included) — retained as a seam, always True."""
+        return True
 
     def _masked_presence(self, counts: np.ndarray, topology_key: str,
                          feasible: np.ndarray
@@ -260,7 +265,17 @@ class AffinityCompiler:
         domain-weight accumulation (scoring.go) over the pod's FEASIBLE
         nodes, vectorized: the pod's preferred (anti-)terms weigh matching
         residents by domain; residents' preferred terms + required terms
-        (× hardPodAffinityWeight) weigh back symmetrically."""
+        (× hardPodAffinityWeight) weigh back symmetrically. Cached by
+        (pod content signature, feasible-mask bytes): template batches
+        share one row per distinct feasibility class. Shared array — do
+        not mutate."""
+        ck = (pod.namespace, tuple(sorted(pod.labels.items())),
+              repr(pod.preferred_affinity_terms),
+              repr(pod.preferred_anti_affinity_terms),
+              hard_weight, feasible.tobytes())
+        cached = self._score_row_cache.get(ck)
+        if cached is not None:
+            return cached
         row = np.zeros((self.n_pad,), dtype=np.float32)
         for term in pod.preferred_affinity_terms:
             t = term.get("podAffinityTerm") or {}
@@ -286,8 +301,9 @@ class AffinityCompiler:
             hit = self._sym_match_cache.get(mk)
             if hit is None:
                 nses = _term_ns(term, owner_ns, self.ns_resolver)
-                hit = pod.namespace in nses and from_label_selector(
-                    term.get("labelSelector")).matches(pod.labels)
+                hit = ns_contains(nses, pod.namespace) and \
+                    from_label_selector(
+                        term.get("labelSelector")).matches(pod.labels)
                 self._sym_match_cache[mk] = hit
             if not hit:
                 continue
@@ -296,12 +312,15 @@ class AffinityCompiler:
             w = hard_weight if is_hard else 1.0
             row += w * np.where(has_key, per_node, 0.0)
         row[self.n_real:] = 0.0
+        self._score_row_cache[ck] = row
         return row
 
     def _self_matches(self, pod: PodInfo) -> bool:
         from kubernetes_tpu.api.labels import from_label_selector
         for t in pod.required_affinity_terms:
-            if pod.namespace not in _term_ns(t, pod.namespace, self.ns_resolver):
+            if not ns_contains(
+                    _term_ns(t, pod.namespace, self.ns_resolver),
+                    pod.namespace):
                 return False
             if not from_label_selector(t.get("labelSelector")).matches(pod.labels):
                 return False
@@ -327,11 +346,19 @@ class AffinityCompiler:
             self._mask_cache[key] = row
         return row
 
+    def spread_constraint_ns(self, constraint: dict,
+                             pod_ns: str) -> tuple[str, ...]:
+        """A spread constraint's effective namespace set (plain
+        constraints count within the pod's own namespace;
+        namespaceSelector resolves like an affinity term's)."""
+        return _term_ns(constraint, pod_ns, self.ns_resolver)
+
     def _spread_domain_counts(self, pod: PodInfo, constraint: dict):
         """Per-constraint: (per_node_count, has_key, eligible, min_count).
 
         Host semantics (_build_state): only eligible nodes' pods count and
-        only eligible domains exist; min is over eligible domains."""
+        only eligible domains exist; min is over eligible domains, floored
+        to 0 when fewer eligible domains exist than minDomains."""
         key = "spread/" + repr((constraint, pod.namespace,
                                 pod.node_selector,
                                 pod.affinity.get("nodeAffinity"),
@@ -339,7 +366,8 @@ class AffinityCompiler:
         got = self._mask_cache.get(key)
         if got is None:
             sel = constraint.get("labelSelector")
-            counts = self.counts_for(sel, (pod.namespace,))
+            counts = self.counts_for(
+                sel, self.spread_constraint_ns(constraint, pod.namespace))
             elig = self.eligibility_row(pod)
             tk = constraint["topologyKey"]
             dom_ids, num = self.topo.domains(tk)
@@ -350,8 +378,12 @@ class AffinityCompiler:
             # others are fresh (None in the host dict → constraint passes).
             exists = _seg_sum(active.astype(np.float32), dom_ids, num) > 0
             exists[0] = False
-            mins = d[exists] if exists.any() else None
-            min_count = float(mins.min()) if mins is not None else 0.0
+            n_existing = int(exists.sum())
+            md = int(constraint.get("minDomains") or 0)
+            if md and n_existing < md:
+                min_count = 0.0
+            else:
+                min_count = float(d[exists].min()) if n_existing else 0.0
             got = (d[dom_ids], has_key, exists[dom_ids], min_count)
             self._mask_cache[key] = got
         return got
@@ -365,9 +397,11 @@ class AffinityCompiler:
             per_node, has_key, exists, min_count = \
                 self._spread_domain_counts(pod, c)
             max_skew = c.get("maxSkew", 1)
-            # selfMatchNum (filtering.go): count the incoming pod only if the
-            # constraint's selector matches the pod's own labels.
-            self_match = 1 if from_label_selector(
+            # selfMatchNum (filtering.go): count the incoming pod only if
+            # the constraint's selector + namespace set match the pod.
+            self_match = 1 if ns_contains(
+                self.spread_constraint_ns(c, pod.namespace),
+                pod.namespace) and from_label_selector(
                 c.get("labelSelector")).matches(pod.labels) else 0
             ok = (~exists) | (per_node + self_match - min_count <= max_skew)
             row &= has_key & ok

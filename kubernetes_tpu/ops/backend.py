@@ -13,10 +13,19 @@ batched assignment solve (ops/solver.py). The plugin contract is preserved:
   node-set epoch and are cached as dense rows (template-derived workloads
   have a handful of signatures). Semantics are *exactly* the host plugin's —
   the cached row is produced by calling its `filter()`/`score()`.
-- Stateful irregular plugins (InterPodAffinity, PodTopologySpread, NodePorts)
-  fall back to host rows per pod, only for pods whose spec activates them
-  (PreFilter Skip detection) — per-extension-point backend selection, the
-  `TPUScorer` feature-gate contract from SURVEY §5.6.
+- The constraint families are DEVICE-RESIDENT end to end: InterPodAffinity
+  compiles every term shape (namespaceSelector included — resolved to
+  namespace sets at table-build time) into dense rows over interned label
+  signatures, and PodTopologySpread rides the union scan table
+  (heterogeneous templates, minDomains, restricted node eligibility,
+  non-self-matching selectors). Host score planes ship as a row
+  DICTIONARY (distinct per-signature rows + per-pod index, gathered on
+  device) whenever a chunk has few distinct rows — the (P,N) dense plane
+  upload was the relay-attached families' dominant cost.
+- The remaining per-pod host rows (NodePorts conflicts, volume plugins,
+  DRA shapes the tensors can't answer) are Skip-gated per pod and COUNTED
+  (kind="host_fallback"; bench detail `host_fallback_pods`) — residency
+  regressions are data, not stderr noise.
 
 Per-plugin unsat masks are kept (not fused away) so FailedScheduling events
 retain per-plugin reasons (SURVEY §5.5 explainability requirement); they are
@@ -39,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.api.labels import ns_contains
 from kubernetes_tpu.ops import kernels, solver
 from kubernetes_tpu.ops.tensorize import ClusterTensors, PodBatch
 from kubernetes_tpu.scheduler.framework import (
@@ -68,6 +78,15 @@ _PIPELINE_DEPTH_OVERRIDE = int(os.environ["KTPU_PIPELINE_DEPTH"]) \
 #: Solve chunk before the tuner has decided (also the latency-bound dirty
 #: pick, so a wrong warmup guess is never catastrophic).
 _DEFAULT_CHUNK = 1024
+
+#: Row-dictionary score wire width: when every host score contribution in
+#: a chunk comes from ≤ SCORE_ROWS_PAD-1 distinct per-signature rows
+#: (template batches — the constraint families' normal case), the wire
+#: ships (SCORE_ROWS_PAD, N) rows + a (P,) index instead of the dense
+#: (P, N) plane and the device gathers. A 2048×5120 f16 plane is ~20 MB
+#: per chunk — at the relay's ~12 MB/s that upload ALONE capped the
+#: affinity families; the dictionary is ~80 KB. Row 0 is reserved zero.
+SCORE_ROWS_PAD = 8
 
 
 class AdaptiveTuner:
@@ -244,9 +263,11 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
 @partial(jax.jit, static_argnames=("strategy", "use_spread"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                        taint_f_mat, taint_p_mat, static_mask, host_scores,
+                       score_rows, score_idx,
                        fit_col_w, bal_col_mask, shape_u, shape_s,
                        w_fit, w_bal, w_taint, taint_filter_on,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
+                       sp_min_ok, sp_haskey,
                        sp_applies, sp_contrib, perms, gang_onehot,
                        gang_required, strategy: str, use_spread: bool):
     """One fused device pass: plugin masks → scores → assignment → state.
@@ -271,7 +292,10 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
     static_mask = ((static_mask[:, :, None] >> shifts) & 1).reshape(
         static_mask.shape[0], -1).astype(jnp.bool_)[:, : alloc_q.shape[0]]
-    host_scores = host_scores.astype(jnp.float32)
+    # Host scores = dense plane + row-dictionary gather (row 0 is zero,
+    # so the unused side of either path contributes nothing).
+    host_scores = host_scores.astype(jnp.float32) \
+        + score_rows.astype(jnp.float32)[score_idx]
 
     r = alloc_q.shape[1]
     tf = taint_f_mat.shape[1]
@@ -305,7 +329,7 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
             static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal, strategy,
             dom_onehot, cid_onehot, dom_counts, max_skew,
-            sp_applies, sp_contrib)
+            sp_min_ok, sp_haskey, sp_applies, sp_contrib)
         assign = solver.gang_filter(a0, gang_onehot, gang_required)
         # Gang-dropped pods bumped the chained counts in-scan (for the
         # constraints they CONTRIBUTE to) — fold them back out so later
@@ -405,6 +429,9 @@ class TPUBackend:
         # dominates wall-clock on a remote-attached TPU. Keyed by shape.
         self._dev_base_mask: dict[tuple, object] = {}
         self._dev_zero_scores: dict[tuple, object] = {}
+        #: zero (row-dictionary, index) pair for chunks with no
+        #: dictionary-form scores (see SCORE_ROWS_PAD).
+        self._dev_zero_srows: dict[tuple, tuple] = {}
         # Static per-snapshot arrays (alloc, taints) re-uploaded only when
         # the node-static fingerprint moves.
         self._dev_static: dict[str, object] = {}
@@ -668,23 +695,30 @@ class TPUBackend:
                  self._put(np.zeros((1, 1), np.float32)),
                  self._put(np.zeros((1,), np.float32)),
                  self._put(np.zeros((1,), np.float32)),
+                 self._put(np.zeros((1,), np.float32)),
+                 self._put(np.zeros((n_pad, 1), np.float32), "nodes_mat"),
                  self._put(np.zeros((p, 1), np.float32)),
                  self._put(np.zeros((p, 1), np.float32)))
             self._spread_dummy_cache[key] = d
         return d
 
     @staticmethod
-    def _spread_tpl_key(cs: list, ns: str) -> str:
+    def _spread_tpl_key(cs: list, pj: PodInfo) -> str:
         # EVERY semantic field participates: two templates differing only
-        # in minDomains/namespaceSelector must NOT collide (the eligible
-        # one would otherwise lend its scan slot to the unmodelable one,
-        # silently dropping that constraint).
+        # in minDomains/namespaceSelector must NOT collide. The pod's
+        # node-eligibility signature participates too — eligibility folds
+        # into the template's constraint COLUMNS (domain membership and
+        # counts are per eligible-node set), so pods with different
+        # nodeSelector/affinity/tolerations need different columns even
+        # for identical constraint lists.
         return repr((sorted((c.get("topologyKey", ""),
                              repr(c.get("labelSelector")),
                              c.get("maxSkew", 1),
                              repr(c.get("minDomains")),
                              repr(c.get("namespaceSelector")))
-                            for c in cs), ns))
+                            for c in cs), pj.namespace,
+                     pj.node_selector,
+                     pj.affinity.get("nodeAffinity"), pj.tolerations))
 
     def _build_spread_table(self, ctx, snapshot, ct, compiler,
                             plugin) -> None:
@@ -694,11 +728,14 @@ class TPUBackend:
         its constraints to one union list C; the scan gates each pod on
         ITS template's columns (`applies`) and counts every placed pod in
         the constraints its labels match (`contributes`) — heterogeneous
-        batches and cross-matching non-spread pods stay on device.
-        Templates the tensors can't model (namespaceSelector, minDomains,
-        restricted node eligibility, non-self-matching selectors) are
-        marked ineligible: their PODS take host rows + stateful verify,
-        everyone else keeps the scan."""
+        batches and cross-matching non-spread pods stay on device. Every
+        template shape compiles: namespaceSelector resolves to a
+        namespace set at build time, minDomains becomes the per-
+        constraint `min_ok` floor, restricted node eligibility folds into
+        the template's domain columns, and non-self-matching selectors
+        ride the per-pod selfMatch term (`contributes`). Templates whose
+        constraints have NO domains anywhere get a static row (reject
+        keyless nodes, fresh-pass the rest) instead of host fallback."""
         from kubernetes_tpu.api.labels import from_label_selector
         from kubernetes_tpu.ops.affinity import _seg_sum
 
@@ -710,86 +747,100 @@ class TPUBackend:
                 cs = plugin._constraints_for(pj, "DoNotSchedule")
                 if not cs:
                     continue
-                key = self._spread_tpl_key(cs, pj.namespace)
+                key = self._spread_tpl_key(cs, pj)
                 t = templates.get(key)
                 if t is None:
                     t = templates[key] = {
-                        "cons": cs, "ns": pj.namespace, "pods": [],
-                        "eligible": not any(
-                            c.get("namespaceSelector")
-                            or c.get("minDomains") for c in cs),
+                        "cons": cs, "ns": pj.namespace, "rep": pj,
                         "sels": [from_label_selector(
                             c.get("labelSelector")) for c in cs],
                     }
-                t["pods"].append(pj)
-                if t["eligible"]:
-                    if not all(s.matches(pj.labels) for s in t["sels"]):
-                        t["eligible"] = False  # non-self-matching member
-                    elif not compiler.eligibility_row(
-                            pj)[: ct.n_real].all():
-                        t["eligible"] = False  # per-pod node eligibility
 
         cons: list[dict] = []      # union constraint list
-        con_ns: list[str] = []
+        con_ns: list[tuple] = []   # resolved namespace set per constraint
         con_sels: list = []
+        con_elig: list[np.ndarray] = []
         tpl_cols: dict[str, list[int]] = {}
+        static_rows: dict[str, np.ndarray] = {}
         for key, t in templates.items():
-            if not t["eligible"]:
+            # A template whose every constraint has zero eligible domains
+            # imposes only the static has-key gate (each keyed node is a
+            # "fresh" domain that placements never populate — counting is
+            # over eligible nodes only), so its pods take one static row
+            # and skip the scan entirely.
+            elig = compiler.eligibility_row(t["rep"])
+            if not any(
+                    (compiler.topo.has_key(c["topologyKey"]) & elig).any()
+                    for c in t["cons"]):
+                row = np.ones((ct.n_real,), dtype=np.bool_)
+                for c in t["cons"]:
+                    row &= compiler.topo.has_key(
+                        c["topologyKey"])[: ct.n_real]
+                static_rows[key] = row
                 continue
             cols = []
             for cidx, c in enumerate(t["cons"]):
                 cols.append(len(cons))
                 cons.append(c)
-                con_ns.append(t["ns"])
+                con_ns.append(
+                    compiler.spread_constraint_ns(c, t["ns"]))
                 con_sels.append(t["sels"][cidx])
+                con_elig.append(elig)
             tpl_cols[key] = cols
 
         dom_slices = [compiler.topo.domains(c["topologyKey"])
                       for c in cons]
-        D = sum(num - 1 for _, num in dom_slices)
-        if not cons or D == 0:
+        if not cons:
             ctx.spread = {"cons": [], "tpl_cols": {},
-                          "ineligible": {k for k, t in templates.items()
-                                         if not t["eligible"]} | set(
-                                             templates)}
+                          "static_rows": static_rows, "ineligible": set()}
             return
 
         N = ct.n_pad
+        C = len(cons)
+        D = 0
+        for cidx, (dom_ids, num) in enumerate(dom_slices):
+            active = (dom_ids > 0) & con_elig[cidx]
+            D += len(np.unique(dom_ids[active]))
         dom_onehot = np.zeros((N, D), dtype=np.float32)
-        cid_onehot = np.zeros((D, len(cons)), dtype=np.float32)
+        cid_onehot = np.zeros((D, C), dtype=np.float32)
         counts0 = np.zeros((D,), dtype=np.float32)
-        val_maps: list[dict] = []
+        has_key_nc = np.zeros((N, C), dtype=np.float32)
+        min_ok = np.ones((C,), dtype=np.float32)
         g = 0
         for cidx, (dom_ids, num) in enumerate(dom_slices):
             counts = compiler.counts_for(
-                cons[cidx].get("labelSelector"), (con_ns[cidx],))
-            d = _seg_sum(np.where(dom_ids > 0, counts, 0.0), dom_ids, num)
-            vmap: dict = {}
-            tk = cons[cidx]["topologyKey"]
-            for k in range(1, num):
-                members = dom_ids == k
-                dom_onehot[members, g] = 1.0
+                cons[cidx].get("labelSelector"), con_ns[cidx])
+            elig = con_elig[cidx]
+            active = (dom_ids > 0) & elig
+            d = _seg_sum(np.where(active, counts, 0.0), dom_ids, num)
+            has_key_nc[:, cidx] = (dom_ids > 0).astype(np.float32)
+            existing = np.unique(dom_ids[active])
+            md = int(cons[cidx].get("minDomains") or 0)
+            if md and len(existing) < md:
+                min_ok[cidx] = 0.0  # minDomains deficit → global min = 0
+            for k in existing:
+                # Domain membership over ELIGIBLE nodes only: placements
+                # on keyed-but-ineligible nodes neither count nor gate.
+                dom_onehot[(dom_ids == k) & elig, g] = 1.0
                 cid_onehot[g, cidx] = 1.0
                 counts0[g] = d[k]
-                rep = int(np.argmax(members[: ct.n_real]))
-                vmap[snapshot.nodes[rep].labels.get(tk)] = g
                 g += 1
-            val_maps.append(vmap)
         # The table is built in _start BEFORE any chunk dispatches, so
         # ctx.delta is empty here by construction — every same-assign
         # placement is counted by the scan itself (sp_contrib).
         ctx.spread = {
             "cons": cons, "con_ns": con_ns, "con_sels": con_sels,
             "tpl_cols": tpl_cols,
-            "ineligible": {k for k, t in templates.items()
-                           if not t["eligible"]},
+            "static_rows": static_rows,
+            "ineligible": set(),
             "dom_onehot_host": dom_onehot,
             "cid_onehot_host": cid_onehot,
-            "val_maps": val_maps,
             "dev_dom": self._put(dom_onehot, "nodes_mat"),
             "dev_cid": self._put(cid_onehot),
             "dev_skew": self._put(np.array(
                 [float(c.get("maxSkew", 1)) for c in cons], np.float32)),
+            "dev_min_ok": self._put(min_ok),
+            "dev_haskey": self._put(has_key_nc, "nodes_mat"),
             "dev_counts": self._put(counts0),
         }
 
@@ -798,12 +849,17 @@ class TPUBackend:
                              fwk) -> list[int]:
         """Hard (DoNotSchedule) PodTopologySpread routing.
 
-        Templates the union table models go to the DEVICE scan
+        Every template rides the DEVICE scan
         (solver.greedy_assign_rescoring_spread): domain counts ride the
         scan carry, so tight maxSkew stays sequential-exact without the
-        batch-then-verify requeue collapse — including heterogeneous
-        batches mixing several templates. Unmodelable templates' pods
-        fall back to host rows + stateful verify, counted (not silent)."""
+        batch-then-verify requeue collapse — heterogeneous batches,
+        namespaceSelector/minDomains constraints, restricted node
+        eligibility, and non-self-matching selectors included. Templates
+        with zero eligible domains take one static has-key row (exact —
+        placements never move their counts). Host rows + stateful verify
+        remain ONLY as the missing-table escape hatch, counted as
+        spread_poisoned degradations (one per pod) — at steady state that
+        counter stays zero."""
         if not spread_pods:
             return []
         compiler = self._affinity_compiler(snapshot, ct)
@@ -817,41 +873,39 @@ class TPUBackend:
             # scan against counts that missed in-flight chunks.
             logger.error("spread table missing at chunk prep; routing "
                          "%d pods to host rows", len(spread_pods))
-            sp = {"tpl_cols": {}}
+            sp = {"tpl_cols": {}, "static_rows": {}}
 
         active: list[int] = []
         fallback: list[tuple[int, object, list]] = []
         for i, pi, cs in spread_pods:
-            key = self._spread_tpl_key(cs, pi.namespace)
+            key = self._spread_tpl_key(cs, pi)
             if key in sp["tpl_cols"]:
                 active.append(i)
-            else:
-                fallback.append((i, pi, cs))
+                continue
+            srow = sp["static_rows"].get(key)
+            if srow is not None:
+                # Zero-domain template: keyless nodes reject, keyed nodes
+                # are fresh — static, no verify needed.
+                if not srow.all():
+                    apply_row("PodTopologySpread", i, srow)
+                continue
+            fallback.append((i, pi, cs))
 
         if fallback:
             if not ctx.spread_poisoned:
                 logger.warning(
-                    "PodTopologySpread: %d pods' templates can't ride the "
-                    "device scan (namespaceSelector/minDomains/eligibility"
-                    "/self-match) — host rows + stateful verify for them",
-                    len(fallback))
-                if self.metrics is not None:
-                    self.metrics.backend_degradations.inc(
-                        kind="spread_poisoned")
+                    "PodTopologySpread: %d pods missed the union table "
+                    "(batch mutated mid-assign?) — host rows + stateful "
+                    "verify for them", len(fallback))
             ctx.spread_poisoned = True
+            if self.metrics is not None:
+                self.metrics.backend_degradations.inc(
+                    len(fallback), kind="spread_poisoned")
             for i, pi, cs in fallback:
-                if not any(c.get("namespaceSelector") for c in cs):
-                    row = compiler.spread_filter_row(pi, cs)[: ct.n_real]
-                    if not row.all():
-                        apply_row("PodTopologySpread", i, row)
-                    stateful_pods.add(i)
-                else:
-                    state = dyn_states.setdefault(i, CycleState())
-                    row = self._dynamic_filter_row(
-                        plugin, pi, ctx.snapshot, ct, state)
-                    if row is not None:
-                        apply_row("PodTopologySpread", i, row)
-                        stateful_pods.add(i)
+                row = compiler.spread_filter_row(pi, cs)[: ct.n_real]
+                if not row.all():
+                    apply_row("PodTopologySpread", i, row)
+                stateful_pods.add(i)
         return active
 
     # -- DynamicResources (DRA) vectorization -------------------------------
@@ -1107,10 +1161,15 @@ class TPUBackend:
         ct = self._tensors(snapshot)
         pods = list(pods)
         # namespaceSelector terms resolve through the framework's
-        # InterPodAffinity plugin (its namespaces informer).
-        ipa = next((p for p in fwk.plugins
-                    if p.NAME == "InterPodAffinity"), None)
-        self._ns_resolver = getattr(ipa, "ns_resolver", None)
+        # InterPodAffinity plugin (its namespaces informer); spread
+        # constraints share the mechanism, so PodTopologySpread's
+        # resolver backs it when no InterPodAffinity profile exists.
+        # Without either, resolve_term_namespaces' static rule applies.
+        src = next((p for p in fwk.plugins
+                    if p.NAME == "InterPodAffinity"), None) or next(
+            (p for p in fwk.plugins
+             if p.NAME == "PodTopologySpread"), None)
+        self._ns_resolver = getattr(src, "ns_resolver", None)
         ctx = _AssignCtx()
         ctx.snapshot, ctx.fwk, ctx.ct = snapshot, fwk, ct
         ctx.chunks = [pods[lo:lo + self.max_batch]
@@ -1128,8 +1187,9 @@ class TPUBackend:
         # Device-side PodTopologySpread union table: built EAGERLY when
         # any pod in the batch carries spread constraints, so chunks
         # dispatched before the first spread pod still count their
-        # selector-matching placements; pods of unmodelable templates
-        # fall back to host verification (spread_poisoned observability).
+        # selector-matching placements. Every template shape compiles;
+        # the host fallback remains only as the missing-table escape
+        # hatch (spread_poisoned observability, steady-state zero).
         ctx.spread = None
         ctx.spread_poisoned = False
         ctx.spread_last_gated = -1
@@ -1154,7 +1214,7 @@ class TPUBackend:
                             cs = sp_plugin._constraints_for(
                                 pj, "DoNotSchedule")
                             if cs and self._spread_tpl_key(
-                                    cs, pj.namespace) in cols:
+                                    cs, pj) in cols:
                                 ctx.spread_last_gated = k
                                 break
         ctx.params = self._fwk_params(fwk, ct)
@@ -1267,6 +1327,10 @@ class TPUBackend:
         #: delta verify inside _verify (routed by delta_has_terms /
         #: has_affinity_constraints), not by this set.
         stateful_pods: set[int] = set()
+        #: DISTINCT pods that took at least one per-pod host plugin row
+        #: this chunk — counted once per pod (not per plugin) into
+        #: backend_degradations{kind="host_fallback"} below.
+        fallback_pods: set[int] = set()
 
         def apply_row(pname: str, i: int, row: np.ndarray) -> None:
             # All-true rows are no-ops; applying them would dirty the mask
@@ -1279,6 +1343,13 @@ class TPUBackend:
                 ok = host_filter_fail[pname] = np.ones((P, N), dtype=np.bool_)
             ok[i, : ct.n_real] &= row
             _get_mask()[i, : ct.n_real] &= row
+
+        #: shared-row groups for the tensorized InterPodAffinity rows:
+        #: template batches produce ONE row object per signature, so the
+        #: per-pod O(N) mask AND collapses to one vectorized write per
+        #: distinct row (id-keyed — filter_row returns cached objects).
+        ipa_groups: dict[int, tuple[np.ndarray, list[int]]] = {}
+        compiler = None
 
         for plugin in fwk.filter_plugins:
             if plugin.NAME in DEVICE_FILTER_PLUGINS:
@@ -1301,13 +1372,16 @@ class TPUBackend:
                     if plugin.NAME == "InterPodAffinity":
                         # Tensorized path (ops/affinity.py): dense per-term
                         # masks over interned label signatures instead of
-                        # O(N) host plugin calls per pod.
-                        compiler = self._affinity_compiler(snapshot, ct)
-                        if compiler.supported(pi):
-                            row = compiler.filter_row(pi)[: ct.n_real]
-                            if not row.all():
-                                apply_row(plugin.NAME, i, row)
-                            continue
+                        # O(N) host plugin calls per pod. Rows group by
+                        # identity for one vectorized apply below.
+                        if compiler is None:
+                            compiler = self._affinity_compiler(snapshot, ct)
+                        row_full = compiler.filter_row(pi)
+                        grp = ipa_groups.get(id(row_full))
+                        if grp is None:
+                            grp = ipa_groups[id(row_full)] = (row_full, [])
+                        grp[1].append(i)
+                        continue
                     if plugin.NAME == "NodeResourceTopologyMatch":
                         # Vectorized zone-alignment rows from batch-start
                         # zone state; in-batch drift → stateful re-check.
@@ -1328,6 +1402,8 @@ class TPUBackend:
                             state = dyn_states.setdefault(i, CycleState())
                             row = self._dynamic_filter_row(
                                 plugin, pi, snapshot, ct, state)
+                            if row is not None:
+                                fallback_pods.add(i)
                         if row is not None and not row.all():
                             apply_row(plugin.NAME, i, row)
                         stateful_pods.add(i)
@@ -1343,12 +1419,31 @@ class TPUBackend:
                     row = self._dynamic_filter_row(plugin, pi, snapshot, ct, state)
                     if row is not None:
                         apply_row(plugin.NAME, i, row)
+                        # Per-pod host-row residency is DATA (bench detail
+                        # host_fallback_pods), not just stderr noise.
+                        fallback_pods.add(i)
                     # NodePorts conflicts only affect pods with ports (each
                     # is individually re-verified); cross-pod plugins flip
                     # the whole batch into full re-verification. row None
                     # means the plugin itself skipped after all.
                     if plugin.NAME != "NodePorts" and row is not None:
                         stateful_pods.add(i)
+
+        if fallback_pods and self.metrics is not None:
+            self.metrics.backend_degradations.inc(
+                len(fallback_pods), kind="host_fallback")
+
+        for row_full, idxs in ipa_groups.values():
+            row = row_full[: ct.n_real]
+            if row.all():
+                continue
+            ok = host_filter_fail.get("InterPodAffinity")
+            if ok is None:
+                ok = host_filter_fail["InterPodAffinity"] = np.ones(
+                    (P, N), dtype=np.bool_)
+            idx = np.asarray(idxs, dtype=np.intp)[:, None]
+            ok[idx, : ct.n_real] &= row[None, :]
+            _get_mask()[idx, : ct.n_real] &= row[None, :]
 
         spread_active_idx = self._process_spread_pods(
             spread_pods, pods, ctx, snapshot, ct, apply_row, stateful_pods,
@@ -1366,7 +1461,7 @@ class TPUBackend:
             active_set = set(spread_active_idx)
             for i, pi, cs in spread_pods:
                 if i in active_set:
-                    key = self._spread_tpl_key(cs, pi.namespace)
+                    key = self._spread_tpl_key(cs, pi)
                     for c in spt["tpl_cols"].get(key, ()):
                         sp_applies[i, c] = 1.0
             memo = spt.setdefault("contrib_memo", {})
@@ -1379,7 +1474,7 @@ class TPUBackend:
                 row = memo.get(sig)
                 if row is None:
                     row = np.fromiter(
-                        (1.0 if (pi.namespace == con_ns[c]
+                        (1.0 if (ns_contains(con_ns[c], pi.namespace)
                                  and con_sels[c].matches(pi.labels))
                          else 0.0 for c in range(C)),
                         dtype=np.float32, count=C)
@@ -1407,11 +1502,26 @@ class TPUBackend:
                 scores_modified = True
             return host_scores
 
+        #: pod FEASIBILITY-CLASS key: (fit class, taint class, the pod's
+        #: host-written mask row) — pods of one template share it, so the
+        #: per-pod O(N) nonzero/normalize work below runs once per class.
+        feas_memo: dict[tuple, np.ndarray] = {}
+        norm_memo: dict[tuple, tuple] = {}
+
+        def pod_class_key(i: int) -> tuple:
+            mrow = static_mask[i, : ct.n_real].tobytes() \
+                if static_mask is not None else None
+            return (batch.req_class[i], batch.untol_class[i], mrow)
+
         def feasible_idx(i: int) -> np.ndarray:
             # Class-level masks: one row per DISTINCT request/toleration
             # shape (equivalence classes), not per pod — the (P,N,R)
             # broadcast was a top host cost for score-bearing families.
             nonlocal fit_np, taint_np
+            pk = pod_class_key(i)
+            got = feas_memo.get(pk)
+            if got is not None:
+                return got
             if fit_np is None:
                 uq = np.stack(batch.req_rows)  # (n_classes, R)
                 fit_np = np.all(
@@ -1430,7 +1540,8 @@ class TPUBackend:
                 & taint_np[batch.untol_class[i], : ct.n_real]
             if static_mask is not None:
                 feas = feas & static_mask[i, : ct.n_real]
-            return np.nonzero(feas)[0]
+            got = feas_memo[pk] = np.nonzero(feas)[0]
+            return got
 
         for name, plugin in score_plugins.items():
             if name in DEVICE_SCORE_PLUGINS:
@@ -1462,47 +1573,76 @@ class TPUBackend:
                         continue
                     if name == "PodTopologySpread":
                         # Tensorized raw counts + vectorized NormalizeScore
-                        # (min-max inversion over the feasible set).
+                        # (min-max inversion over the feasible set) — every
+                        # constraint shape, namespaceSelector included.
+                        # Memoized per (feasibility class, pod signature):
+                        # template batches normalize once.
                         constraints = plugin._constraints_for(
                             pi, "ScheduleAnyway")
-                        if not any(c.get("namespaceSelector")
-                                   for c in constraints):
-                            compiler = self._affinity_compiler(snapshot, ct)
+                        nk = ("pts", pod_class_key(i), pi.namespace,
+                              tuple(sorted(pi.labels.items())),
+                              repr(constraints),
+                              repr(pi.node_selector),
+                              repr(pi.affinity.get("nodeAffinity")),
+                              repr(pi.tolerations))
+                        got = norm_memo.get(nk)
+                        if got is None:
+                            if compiler is None:
+                                compiler = self._affinity_compiler(
+                                    snapshot, ct)
                             raw_row = compiler.spread_raw_scores(
                                 pi, constraints)[: ct.n_real]
                             feas = feasible_idx(i)
+                            wnorm = None
                             if feas.size:
                                 vals = raw_row[feas]
                                 mx, mn = vals.max(), vals.min()
                                 if mx > mn:
-                                    norm = 100.0 * (mx - vals) / (mx - mn)
+                                    wnorm = w * 100.0 * (mx - vals) \
+                                        / (mx - mn)
                                 else:
-                                    norm = np.full_like(vals, 100.0)
-                                _get_scores()[i, feas] += w * norm
-                            continue
+                                    wnorm = np.full_like(vals, w * 100.0)
+                            got = norm_memo[nk] = (feas, wnorm, [])
+                        got[2].append(i)
+                        continue
                     if name == "InterPodAffinity":
                         if not self._ipa_score_relevant(pi, snapshot):
                             # No preferred terms anywhere and no
                             # hard-affinity symmetry sources → every score
                             # is 0; skip the O(N × residents) walk.
                             continue
-                        compiler = self._affinity_compiler(snapshot, ct)
-                        if compiler.score_supported(pi):
+                        # Tensorized for every term shape
+                        # (namespaceSelector terms resolve at compile
+                        # time); memoized per (feasibility class, pod
+                        # signature), so template batches compute and
+                        # normalize once.
+                        nk = ("ipa", pod_class_key(i), pi.namespace,
+                              tuple(sorted(pi.labels.items())),
+                              repr(pi.preferred_affinity_terms),
+                              repr(pi.preferred_anti_affinity_terms))
+                        got = norm_memo.get(nk)
+                        if got is None:
+                            if compiler is None:
+                                compiler = self._affinity_compiler(
+                                    snapshot, ct)
                             feas = feasible_idx(i)
-                            feas_mask = np.zeros((ct.n_pad,), dtype=np.bool_)
+                            feas_mask = np.zeros(
+                                (ct.n_pad,), dtype=np.bool_)
                             feas_mask[feas] = True
                             raw_row = compiler.score_row(
                                 pi, float(getattr(
                                     plugin, "hard_pod_affinity_weight", 1)),
                                 feas_mask)[: ct.n_real]
+                            wnorm = None
                             if feas.size:
                                 vals = raw_row[feas]
                                 mx, mn = vals.max(), vals.min()
                                 if mx > mn:
-                                    norm = 100.0 * (vals - mn) / (mx - mn)
-                                    _get_scores()[i, feas] += w * norm
-                            continue
-                        # namespaceSelector terms → host slow path below.
+                                    wnorm = w * 100.0 * (vals - mn) \
+                                        / (mx - mn)
+                            got = norm_memo[nk] = (feas, wnorm, [])
+                        got[2].append(i)
+                        continue
                     state = dyn_states.setdefault(i, CycleState())
                     nodes_i = [snapshot.nodes[j] for j in feasible_idx(i)]
                     st = plugin.pre_score(state, pi, nodes_i)
@@ -1516,6 +1656,41 @@ class TPUBackend:
                     hs = _get_scores()
                     for nname, s in raw.items():
                         hs[i, ct.name_to_idx[nname]] += w * s
+
+        # Flush of the memoized normalized score rows. Preferred form:
+        # the ROW-DICTIONARY wire — distinct (combination of) rows +
+        # per-pod index, gathered on device — which never materializes
+        # the (P,N) plane at all. Falls back to one vectorized scatter
+        # per signature into the dense plane when other plugins already
+        # dirtied it or the chunk has too many distinct rows.
+        score_rows_np = score_idx_np = None
+        live = [e for e in norm_memo.values()
+                if e[1] is not None and e[2]]
+        if live:
+            pod_groups: dict[int, list[int]] = {}
+            for g, (feas, wnorm, idxs) in enumerate(live):
+                for i in idxs:
+                    pod_groups.setdefault(i, []).append(g)
+            combos: dict[tuple, list[int]] = {}
+            for i, gs in pod_groups.items():
+                combos.setdefault(tuple(gs), []).append(i)
+            if host_scores is None and len(combos) <= SCORE_ROWS_PAD - 1:
+                dense = []
+                for feas, wnorm, idxs in live:
+                    r = np.zeros((N,), dtype=np.float32)
+                    r[feas] = wnorm
+                    dense.append(r)
+                score_rows_np = np.zeros(
+                    (SCORE_ROWS_PAD, N), dtype=np.float32)
+                score_idx_np = np.zeros((P,), dtype=np.int32)
+                for k, (gs, idxs) in enumerate(combos.items(), start=1):
+                    for g in gs:
+                        score_rows_np[k] += dense[g]
+                    score_idx_np[np.asarray(idxs, dtype=np.intp)] = k
+            else:
+                for feas, wnorm, idxs in live:
+                    _get_scores()[np.ix_(
+                        np.asarray(idxs, dtype=np.intp), feas)] += wnorm
 
         # Reuse device-resident constants when untouched (remote-TPU upload
         # bandwidth is the bottleneck at 5k nodes). Dirty uploads are
@@ -1537,6 +1712,17 @@ class TPUBackend:
             if dev_scores is None:
                 dev_scores = self._dev_zero_scores[(P, N)] = \
                     self._put(np.zeros((P, N), dtype=np.float16), "pn")
+        if score_rows_np is not None:
+            dev_srows = self._put(compress_score_wire(score_rows_np), "pn")
+            dev_sidx = self._put(score_idx_np)
+        else:
+            z = self._dev_zero_srows.get((P, N))
+            if z is None:
+                z = self._dev_zero_srows[(P, N)] = (
+                    self._put(np.zeros((SCORE_ROWS_PAD, N),
+                                       dtype=np.float16), "pn"),
+                    self._put(np.zeros((P,), dtype=np.int32)))
+            dev_srows, dev_sidx = z
 
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are
@@ -1629,6 +1815,7 @@ class TPUBackend:
         return {
             "pods": pods, "batch": batch,
             "dev_mask": dev_mask, "dev_scores": dev_scores,
+            "dev_srows": dev_srows, "dev_sidx": dev_sidx,
             "host_filter_fail": host_filter_fail,
             "unknown_res": unknown_res, "stateful_pods": stateful_pods,
             "spread_active_idx": spread_active_idx,
@@ -1673,7 +1860,8 @@ class TPUBackend:
         prep["spread_used"] = use_spread
         if use_spread:
             sp_args = (sp["dev_dom"], sp["dev_cid"], sp["dev_counts"],
-                       sp["dev_skew"], self._put(prep["sp_applies"]),
+                       sp["dev_skew"], sp["dev_min_ok"], sp["dev_haskey"],
+                       self._put(prep["sp_applies"]),
                        self._put(prep["sp_contrib"]))
         else:
             sp_args = self._spread_dummies(ct.n_pad, batch.req_q.shape[0])
@@ -1683,6 +1871,7 @@ class TPUBackend:
                 self._dev_static["alloc_pods"], self._put(pod_pack),
                 self._dev_static["taint_f"], self._dev_static["taint_p"],
                 prep["dev_mask"], prep["dev_scores"],
+                prep["dev_srows"], prep["dev_sidx"],
                 p["fit_col_w"], p["bal_col_mask"], p["shape_u"], p["shape_s"],
                 p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
                 *sp_args,
@@ -1716,8 +1905,7 @@ class TPUBackend:
         # then they re-enter the stateful set, restoring exactness.
         stateful = run["stateful_pods"]
         # (Templates are fixed at table-build time from ALL chunks, so a
-        # later chunk can no longer invalidate scan-trusted placements —
-        # ineligible templates' pods were already routed stateful.)
+        # later chunk can no longer invalidate scan-trusted placements.)
         rejects = self._verify(pods, assign, ctx, stateful)
 
         # Fold verify rejections back into the device-chained used-state so
@@ -2065,16 +2253,17 @@ class _AssignCtx:
 def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict,
                     resolver=None):
     """Compiled (namespace-set, Selector) per unique term — the delta loop
-    is O(batch²) pairs, so per-pair selector re-parsing would dominate."""
+    is O(batch²) pairs, so per-pair selector re-parsing would dominate.
+    The namespace set may be the ALL_NAMESPACES wildcard; membership goes
+    through labels.ns_contains."""
     key = (id(term), owner_ns)
     got = sel_cache.get(key)
     if got is None:
         from kubernetes_tpu.api.labels import from_label_selector
-        if resolver is not None and \
-                term.get("namespaceSelector") is not None:
-            nses = frozenset(resolver(term, owner_ns))
-        else:
-            nses = frozenset(term.get("namespaces") or [owner_ns])
+        from kubernetes_tpu.scheduler.plugins.interpodaffinity import (
+            resolve_term_namespaces,
+        )
+        nses = frozenset(resolve_term_namespaces(term, owner_ns, resolver))
         got = sel_cache[key] = (nses, from_label_selector(
             term.get("labelSelector")))
     return got
@@ -2126,7 +2315,7 @@ class _DeltaAffinityIndex:
             counts: dict = {}
             total = 0
             for d, labels_m in delta:  # back-fill placements so far
-                if d.namespace in nses and sel.matches(d.labels):
+                if ns_contains(nses, d.namespace) and sel.matches(d.labels):
                     v = labels_m.get(tk)
                     counts[v] = counts.get(v, 0) + 1
                     total += 1
@@ -2136,7 +2325,7 @@ class _DeltaAffinityIndex:
     def add(self, d, node_labels: Mapping) -> None:
         for e in self.fwd.values():
             nses, sel, tk, counts, _total = e
-            if d.namespace in nses and sel.matches(d.labels):
+            if ns_contains(nses, d.namespace) and sel.matches(d.labels):
                 v = node_labels.get(tk)
                 counts[v] = counts.get(v, 0) + 1
                 e[4] += 1
@@ -2174,7 +2363,8 @@ def _delta_affinity_ok(pi, ni, delta, ct, compiler, sel_cache,
             nses, sel, tk, counts, _total = e
             tv = labels_n.get(tk)
             if tv is not None and counts.get(tv) \
-                    and pi.namespace in nses and sel.matches(pi.labels):
+                    and ns_contains(nses, pi.namespace) \
+                    and sel.matches(pi.labels):
                 return False
         # (3) pi's required affinity: delta pods can only ADD matches; the
         # one invalidation is the first-pod-in-group escape — once a
@@ -2204,7 +2394,7 @@ def _delta_affinity_ok(pi, ni, delta, ct, compiler, sel_cache,
     def matches(term, owner_ns, other) -> bool:
         nses, sel = _cached_matcher(term, owner_ns, sel_cache,
                                     getattr(compiler, "ns_resolver", None))
-        return other.namespace in nses and sel.matches(other.labels)
+        return ns_contains(nses, other.namespace) and sel.matches(other.labels)
 
     # (1) pi's own anti-affinity vs delta placements.
     for term in pi.required_anti_affinity_terms:

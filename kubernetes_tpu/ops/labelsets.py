@@ -23,7 +23,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
-from kubernetes_tpu.api.labels import from_label_selector
+from kubernetes_tpu.api.labels import from_label_selector, ns_contains
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
 
 
@@ -65,7 +65,8 @@ class LabelSigTable:
     def match_vec(self, label_selector: Mapping | None,
                   namespaces: Sequence[str]) -> np.ndarray:
         """(U,) float32: 1.0 where the signature's namespace ∈ namespaces and
-        its labels match the selector — the exact host Selector semantics."""
+        its labels match the selector — the exact host Selector semantics.
+        `namespaces` may be labels.ALL_NAMESPACES ("*",) = every namespace."""
         key = repr((label_selector, tuple(namespaces)))
         vec = self._match_cache.get(key)
         if vec is None:
@@ -73,7 +74,7 @@ class LabelSigTable:
             nset = set(namespaces)
             vec = np.zeros((max(1, len(self.sig_examples)),), dtype=np.float32)
             for u, pi in enumerate(self.sig_examples):
-                if pi.namespace in nset and sel.matches(pi.labels):
+                if ns_contains(nset, pi.namespace) and sel.matches(pi.labels):
                     vec[u] = 1.0
             self._match_cache[key] = vec
         return vec

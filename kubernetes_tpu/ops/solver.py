@@ -121,7 +121,8 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
                                    fit_col_w, bal_col_mask, shape_u, shape_s,
                                    w_fit, w_bal, strategy: str,
                                    dom_onehot, cid_onehot, dom_counts,
-                                   max_skew, applies, contributes):
+                                   max_skew, min_ok, has_key_nc,
+                                   applies, contributes):
     """greedy_assign_rescoring + PodTopologySpread hard constraints INSIDE
     the scan (sequential-equivalent, like capacity).
 
@@ -131,21 +132,35 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
     collapses to a requeue loop. The domain counts ride the scan carry
     instead — and the constraint set is the UNION across every spread
     template in the batch, so heterogeneous batches (several templates,
-    plus non-spread pods matching some template's selector) stay on
-    device instead of poisoning to host rows:
+    minDomains/namespaceSelector constraints, restricted node
+    eligibility, non-self-matching selectors, plus non-spread pods
+    matching some template's selector) ALL stay on device:
 
     dom_onehot: (N, D) float32 — node → domain one-hot over the union of
-        ALL constraints' domains (eligible nodes only; a node missing a
-        constraint's topology key has no domain for it and is rejected,
-        DoNotSchedule semantics).
+        ALL constraints' eligible domains (the template's node-eligibility
+        mask is folded in per constraint column: ineligible nodes belong
+        to no domain and neither count nor gate).
     cid_onehot: (D, C) float32 — domain → owning constraint.
-    dom_counts: (D,) float32 — batch-start matching-pod count per domain.
+    dom_counts: (D,) float32 — batch-start matching-pod count per domain
+        (eligible nodes only, the owning constraint's namespace set).
     max_skew:   (C,) float32 per constraint.
+    min_ok:     (C,) float32 — 0.0 when the constraint has fewer eligible
+        domains than its minDomains (global minimum is then treated as 0,
+        the k8s MinDomainsInPodTopologySpread rule), else 1.0.
+    has_key_nc: (N, C) float32 — node HAS the constraint's topology key
+        (regardless of eligibility). Keyless nodes reject
+        (DoNotSchedule); keyed nodes outside every eligible domain pass
+        as "fresh" (the host plugin's count-is-None continue). A keyed-
+        but-INELIGIBLE node whose domain value does exist eligible
+        elsewhere also fresh-passes here — sound because eligibility is
+        the pod's own nodeSelector/affinity/tolerations, so the static
+        and taint masks already reject that node for this pod.
     applies:     (P, C) float32 — constraint c GATES pod p's placement
         (p carries it in its own template).
     contributes: (P, C) float32 — pod p COUNTS toward constraint c when
         placed (namespace + selector match) — computed for every pod in
-        the chunk, spread-constrained or not.
+        the chunk, spread-constrained or not. Doubles as the per-pod
+        selfMatch term of the skew check (filtering.go selfMatchNum).
 
     Returns (assign, dom_counts') so the caller can chain counts across
     chunks on device, exactly like the packed used-state.
@@ -155,20 +170,29 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
     n = free_q.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
     big = jnp.float32(1e30)
+    # Static per-constraint node→eligible-domain membership: nodes outside
+    # it (but keyed) take the fresh-domain pass.
+    in_dom_nc = (dom_onehot @ cid_onehot) > 0                          # (N,C)
+    gate_nc = has_key_nc > 0
 
     def step(carry, inp):
         free_q, free_pods, used_nz, dcounts = carry
         req, req_nz, m, sc_static, app, contrib = inp
-        # min count over each constraint's domains (empty domains included).
+        # min count over each constraint's domains (empty domains included),
+        # floored to 0 under a minDomains deficit.
         min_c = jnp.min(
             jnp.where(cid_onehot > 0, dcounts[:, None], big), axis=0)  # (C,)
-        allowed_d = (dcounts + 1.0 - cid_onehot @ min_c) \
+        min_c = min_c * min_ok
+        self_d = cid_onehot @ contrib                                  # (D,)
+        allowed_d = (dcounts + self_d - cid_onehot @ min_c) \
             <= (cid_onehot @ max_skew)                                 # (D,)
-        node_c_ok = (dom_onehot @ (allowed_d[:, None] * cid_onehot)) > 0
-        # Every constraint THE POD CARRIES: the node must belong to one of
-        # its domains (has_key, DoNotSchedule rejects keyless nodes) AND
-        # that domain's skew must allow one more pod. A node has ≤1 domain
-        # per constraint, so membership-in-allowed covers both.
+        in_allowed = (dom_onehot @ (allowed_d[:, None] * cid_onehot)) > 0
+        # Every constraint THE POD CARRIES: the node must have the
+        # topology key (DoNotSchedule rejects keyless nodes), and if it
+        # belongs to one of the constraint's eligible domains, that
+        # domain's skew must allow this pod's selfMatch increment; keyed
+        # nodes outside every eligible domain are fresh and pass.
+        node_c_ok = gate_nc & (in_allowed | jnp.logical_not(in_dom_nc))
         spread_ok = jnp.all(node_c_ok | (app[None, :] == 0), axis=1)
         fits = m & jnp.all(req[None, :] <= free_q, axis=1) & (free_pods >= 1)
         fits = fits & spread_ok

@@ -22,11 +22,16 @@ nothing contradicts Bind. Same workload YAML shape:
         params: {initNodes: 100, initPods: 500, measurePods: 1000}
 
 Opcodes: createNodes, createPods, barrier, sleep, churn (delete/recreate a
-slice of pods for queue pressure). Metrics collected over the measured
-phase: SchedulingThroughput (pods/s), scheduling_attempt_duration
-percentiles (p50/p90/p99 from the scheduler's own histogram — SURVEY §5.5
-names), and node fragmentation % (mean free-capacity fraction; the
-bin-packing quality metric BASELINE tracks).
+slice of pods for queue pressure), startAgents (N in-process NodeAgents —
+hollow kubelets with field-selector pod watches — register their own
+Nodes in place of kwok-style data staging, so the run carries the
+control-plane cost of N watch consumers + mark-Running writes + lease
+heartbeats). Metrics collected over the measured phase:
+SchedulingThroughput (pods/s), scheduling_attempt_duration percentiles
+(p50/p90/p99 from the scheduler's own histogram — SURVEY §5.5 names),
+node fragmentation % (mean free-capacity fraction; the bin-packing
+quality metric BASELINE tracks), and the backend's device-residency
+counters (host_fallback_pods / spread_poisoned_pods).
 """
 
 from __future__ import annotations
@@ -75,6 +80,13 @@ class WorkloadResult:
         #: result property, not stderr noise.
         self.events_emitted_total = 0
         self.events_dropped_total = 0
+        #: Device-residency accounting over the measured phase (TPU
+        #: backend degradation counters): pods that took per-pod host
+        #: plugin rows, and spread pods that missed the union scan table.
+        #: A residency regression shows up HERE per run, not just in a
+        #: stderr warning.
+        self.host_fallback_pods = 0
+        self.spread_poisoned_pods = 0
 
     def as_dict(self) -> dict:
         import math
@@ -97,6 +109,8 @@ class WorkloadResult:
                 100.0 * self.events_dropped_total
                 / self.events_emitted_total, 2)
             if self.events_emitted_total else 0.0,
+            "host_fallback_pods": self.host_fallback_pods,
+            "spread_poisoned_pods": self.spread_poisoned_pods,
         }
 
 
@@ -220,11 +234,47 @@ class PerfRunner:
         node_count = 0
         pod_seq = 0
         created_total = 0
+        agents: list = []
+        agent_dir: str | None = None
         deadline = time.monotonic() + timeout
         try:
             for op in template_ops:
                 opcode = op["opcode"]
-                if opcode == "createNodes":
+                if opcode == "startAgents":
+                    # Agent-backed staging: N hollow-kubelet NodeAgents
+                    # (kubernetes_tpu/agent) register their own Nodes and
+                    # consume field-selector-filtered pod watches — the
+                    # kubelet topology — instead of createNodes' bare
+                    # data staging. Their mark-Running writes and lease
+                    # renewals ride the same store/wire as the workload.
+                    import tempfile
+
+                    from kubernetes_tpu.agent import NodeAgent
+                    count = _resolve_count(op, params)
+                    tmpl = {**DEFAULT_NODE_TEMPLATE,
+                            **(op.get("nodeTemplate") or {})}
+                    if agent_dir is None:
+                        agent_dir = tempfile.mkdtemp(prefix="ktpu-agents-")
+                    new_agents = [
+                        NodeAgent(store, f"node-{node_count + i}",
+                                  checkpoint_dir=agent_dir,
+                                  node_template=copy.deepcopy(tmpl),
+                                  lease_period=float(
+                                      op.get("leasePeriod", 5.0)))
+                        for i in range(count)]
+                    # Track BEFORE starting so a mid-window start()
+                    # failure still stops every booted agent in the
+                    # finally block (stop() on a never-started agent is
+                    # a no-op). Windowed start: each start() lists +
+                    # opens a watch; a serial loop would make agent boot
+                    # the benchmark.
+                    agents.extend(new_agents)
+                    for lo in range(0, count, 64):
+                        await asyncio.gather(*(
+                            a.start() for a in new_agents[lo:lo + 64]))
+                    node_count += count
+
+                elif opcode == "createNodes":
                     count = _resolve_count(op, params)
                     tmpl = {**DEFAULT_NODE_TEMPLATE,
                             **(op.get("nodeTemplate") or {})}
@@ -405,6 +455,12 @@ class PerfRunner:
                 else:
                     raise ValueError(f"unknown opcode {opcode!r}")
         finally:
+            if agents:
+                await asyncio.gather(
+                    *(a.stop() for a in agents), return_exceptions=True)
+            if agent_dir is not None:
+                import shutil
+                shutil.rmtree(agent_dir, ignore_errors=True)
             await sched.stop()
             run_task.cancel()
             factory.stop()
@@ -432,14 +488,17 @@ class PerfRunner:
 
     @staticmethod
     def _begin_measure(metrics: SchedulerMetrics) -> tuple:
+        deg = metrics.backend_degradations
         return (metrics.attempt_duration.snapshot(
             result="scheduled", profile="default-scheduler"),
-            time.monotonic())
+            time.monotonic(),
+            deg.value(kind="host_fallback"),
+            deg.value(kind="spread_poisoned"))
 
     @staticmethod
     def _end_measure(result: WorkloadResult, metrics: SchedulerMetrics,
                      window: tuple, count: int) -> None:
-        hist_base, t0 = window
+        hist_base, t0, fallback_base, poisoned_base = window
         dt = time.monotonic() - t0
         result.measured_pods = count
         result.measured_seconds = dt
@@ -449,6 +508,11 @@ class PerfRunner:
         result.attempt_p50 = h.percentile_since(0.50, hist_base, **labels)
         result.attempt_p90 = h.percentile_since(0.90, hist_base, **labels)
         result.attempt_p99 = h.percentile_since(0.99, hist_base, **labels)
+        deg = metrics.backend_degradations
+        result.host_fallback_pods = int(
+            deg.value(kind="host_fallback") - fallback_base)
+        result.spread_poisoned_pods = int(
+            deg.value(kind="spread_poisoned") - poisoned_base)
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

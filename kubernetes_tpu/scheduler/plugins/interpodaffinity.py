@@ -29,7 +29,12 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Mapping
 
-from kubernetes_tpu.api.labels import from_label_selector
+from kubernetes_tpu.api.labels import (
+    ALL_NAMESPACES,
+    from_label_selector,
+    is_empty_label_selector,
+    ns_contains,
+)
 from kubernetes_tpu.scheduler.framework import (
     MAX_NODE_SCORE,
     CycleState,
@@ -82,6 +87,12 @@ class NamespaceResolver:
         explicit = term.get("namespaces") or []
         if ns_sel is None:
             return tuple(explicit) if explicit else (owner_ns,)
+        # Empty selector ({}) selects EVERY namespace (reference
+        # semantics: it matches any label set, including namespaces with
+        # no labels and namespaces with no Namespace object) — no
+        # informer needed, and no namespace universe to enumerate.
+        if is_empty_label_selector(ns_sel):
+            return ALL_NAMESPACES
         key = (repr(ns_sel), tuple(explicit))
         got = self._memo.get(key)
         if got is None:
@@ -96,14 +107,32 @@ class NamespaceResolver:
         return got
 
 
+def resolve_term_namespaces(term: Mapping, owner_ns: str,
+                            resolver=None) -> tuple[str, ...]:
+    """A term's effective namespace set, with or without a resolver.
+
+    The resolver-less path is STATIC and resolver-consistent: an empty
+    namespaceSelector ({}) is ALL_NAMESPACES either way; a non-empty
+    selector without an informer matches only the term's explicit
+    `namespaces` (exactly what an informer-less NamespaceResolver
+    resolves to) — so compiled tensor rows and host plugin rows agree
+    by construction."""
+    if resolver is not None:
+        return resolver(term, owner_ns)
+    ns_sel = term.get("namespaceSelector")
+    explicit = term.get("namespaces") or []
+    if ns_sel is None:
+        return tuple(explicit) if explicit else (owner_ns,)
+    if is_empty_label_selector(ns_sel):
+        return ALL_NAMESPACES
+    return tuple(explicit)
+
+
 def _term_matches(term: Mapping, pod_ns: str, other: PodInfo,
                   resolver=None) -> bool:
     """Does `other` match an affinity term owned by a pod in `pod_ns`?"""
-    if resolver is not None:
-        namespaces = resolver(term, pod_ns)
-    else:
-        namespaces = term.get("namespaces") or [pod_ns]
-    if other.namespace not in namespaces:
+    namespaces = resolve_term_namespaces(term, pod_ns, resolver)
+    if not ns_contains(namespaces, other.namespace):
         return False
     return from_label_selector(term.get("labelSelector")).matches(other.labels)
 

@@ -4,8 +4,15 @@ Parity target: pkg/scheduler/framework/plugins/podtopologyspread/
 {plugin.go,filtering.go,scoring.go}:
 
 - Filter (whenUnsatisfiable=DoNotSchedule): placing the pod on a node must
-  keep `count(domain_of(node)) + 1 - min(count over eligible domains) <= maxSkew`
-  for every constraint whose labelSelector matches the pod itself.
+  keep `count(domain_of(node)) + selfMatch - min(count over eligible
+  domains) <= maxSkew` for every constraint (selfMatch = 1 iff the
+  constraint's selector + namespace set match the pod itself).
+- minDomains: when fewer eligible domains exist than minDomains, the
+  global minimum is treated as 0 (k8s MinDomainsInPodTopologySpread).
+- namespaceSelector (extension beyond the reference's spread API): a
+  constraint may widen counting beyond the pod's own namespace, resolved
+  exactly like an affinity term's namespaceSelector
+  (interpodaffinity.resolve_term_namespaces; {} = every namespace).
 - Score (whenUnsatisfiable=ScheduleAnyway): lower resulting skew → higher.
 - Default constraints (SystemDefaulting): maxSkew=3 on hostname /
   maxSkew=5 on zone, ScheduleAnyway — applied when the pod has none.
@@ -19,7 +26,11 @@ from __future__ import annotations
 
 from collections import defaultdict
 
-from kubernetes_tpu.api.labels import from_label_selector, match_node_selector_terms
+from kubernetes_tpu.api.labels import (
+    from_label_selector,
+    match_node_selector_terms,
+    ns_contains,
+)
 from kubernetes_tpu.api.types import (
     TAINT_NO_EXECUTE,
     TAINT_NO_SCHEDULE,
@@ -88,6 +99,24 @@ class PodTopologySpread(Plugin):
         if self.default_constraints is None and self.args.get(
                 "defaultingType", "System") == "System":
             self.default_constraints = DEFAULT_CONSTRAINTS
+        # namespaceSelector constraints resolve like affinity terms
+        # (shared NamespaceResolver; informer-less it still gives the
+        # static {}-is-everything semantics).
+        from kubernetes_tpu.scheduler.plugins.interpodaffinity import (
+            NamespaceResolver,
+        )
+        self.ns_resolver = NamespaceResolver()
+
+    def set_informers(self, factory) -> None:
+        self.ns_resolver.wire(factory)
+
+    def constraint_namespaces(self, c: dict, pod_ns: str) -> tuple:
+        """A constraint's effective namespace set (ALL_NAMESPACES-aware);
+        plain constraints count within the pod's own namespace."""
+        from kubernetes_tpu.scheduler.plugins.interpodaffinity import (
+            resolve_term_namespaces,
+        )
+        return resolve_term_namespaces(c, pod_ns, self.ns_resolver)
 
     def _constraints_for(self, pod: PodInfo, action: str) -> list[dict]:
         cons = pod.topology_spread_constraints
@@ -107,6 +136,7 @@ class PodTopologySpread(Plugin):
         for c in s.constraints:
             tk = c["topologyKey"]
             sel = from_label_selector(c.get("labelSelector"))
+            nses = self.constraint_namespaces(c, pod.namespace)
             counts: dict[str, int] = defaultdict(int)
             for node in nodes:
                 tv = node.labels.get(tk)
@@ -114,11 +144,21 @@ class PodTopologySpread(Plugin):
                     continue
                 counts.setdefault(tv, 0)
                 for existing in node.pods:
-                    if existing.namespace == pod.namespace and sel.matches(existing.labels):
+                    if ns_contains(nses, existing.namespace) \
+                            and sel.matches(existing.labels):
                         counts[tv] += 1
             s.counts.append(dict(counts))
-            s.mins.append(min(counts.values()) if counts else 0)
-            s.self_match.append(1 if sel.matches(pod.labels) else 0)
+            # minDomains (DoNotSchedule only in the API; harmless on the
+            # score path, which never reads mins): fewer eligible domains
+            # than minDomains → global minimum is 0.
+            md = int(c.get("minDomains") or 0)
+            if md and len(counts) < md:
+                s.mins.append(0)
+            else:
+                s.mins.append(min(counts.values()) if counts else 0)
+            s.self_match.append(
+                1 if ns_contains(nses, pod.namespace)
+                and sel.matches(pod.labels) else 0)
         return s
 
     # -- Filter path -------------------------------------------------------

@@ -213,9 +213,11 @@ class TestDeviceSpreadScan:
             counts[zone_of[assignments[p.key]]] += 1
         assert max(counts.values()) - min(counts.values()) <= 1
 
-    def test_mixed_batch_poisons_to_host_path(self):
-        """A batch with two DIFFERENT spread templates falls back to the
-        host verify path and still never violates either constraint."""
+    def test_mixed_batch_rides_union_table_zero_poisoning(self):
+        """A batch with two DIFFERENT spread templates (the Hetero family
+        shape) rides ONE union scan table: both constraints honored,
+        every pod placed, ZERO spread_poisoned degradations."""
+        from kubernetes_tpu.metrics.registry import SchedulerMetrics
         from kubernetes_tpu.ops import TPUBackend
         from kubernetes_tpu.scheduler.framework import Framework
         from kubernetes_tpu.scheduler.plugins.registry import (
@@ -232,20 +234,24 @@ class TestDeviceSpreadScan:
             for i in range(6)]
         fwk = Framework(build_plugins(), DEFAULT_SCORE_WEIGHTS)
         backend = TPUBackend(max_batch=32)
+        backend.metrics = SchedulerMetrics()
         assignments, _ = backend.assign(pods, snapshot, fwk)
         zone_of = {f"n{i}": ZONES[i // 3] for i in range(9)}
         s_counts = {z: 0 for z in ZONES}
         t_counts = {z: 0 for z in ZONES}
         for p in pods:
             node = assignments[p.key]
-            if node is None:
-                continue
+            assert node is not None
             if p.labels["app"] == "s":
                 s_counts[zone_of[node]] += 1
             else:
                 t_counts[zone_of[node]] += 1
         assert max(s_counts.values()) - min(s_counts.values()) <= 1
         assert max(t_counts.values()) - min(t_counts.values()) <= 2
+        assert backend.metrics.backend_degradations.value(
+            kind="spread_poisoned") == 0
+        assert backend.metrics.backend_degradations.value(
+            kind="host_fallback") == 0
 
 
 class _FakeNsInformer:
@@ -288,18 +294,46 @@ class TestNamespaceSelector:
                 "topologyKey": key, "namespaceSelector": ns_sel}
 
     def test_resolver_semantics(self):
+        from kubernetes_tpu.api.labels import ALL_NAMESPACES, ns_contains
         r = resolver_for(self.NAMESPACES)
         t = self.ns_term("web", ZONE, {"matchLabels": {"team": "a"}})
         assert r(t, "default") == ("default", "third")
-        # empty selector ({}) matches every namespace
+        # empty selector ({}) matches EVERY namespace — including ones
+        # without a Namespace object (reference: it matches any label
+        # set) — so it resolves to the wildcard sentinel.
         t_all = self.ns_term("web", ZONE, {})
-        assert r(t_all, "default") == ("default", "other", "third")
+        assert r(t_all, "default") == ALL_NAMESPACES
+        assert ns_contains(r(t_all, "default"), "no-such-namespace")
         # explicit namespaces union with the selector's matches
         t_union = dict(t, namespaces=["other"])
         assert r(t_union, "default") == ("default", "other", "third")
         # nil selector: explicit list or owner namespace
         plain = {"labelSelector": {}, "topologyKey": ZONE}
         assert r(plain, "default") == ("default",)
+
+    def test_static_resolution_without_resolver(self):
+        """resolve_term_namespaces without a resolver: {} selector is the
+        wildcard; non-empty selectors match explicit namespaces only —
+        identical to an informer-less NamespaceResolver, so compiled rows
+        and host rows agree by construction."""
+        from kubernetes_tpu.api.labels import ALL_NAMESPACES
+        from kubernetes_tpu.scheduler.plugins.interpodaffinity import (
+            NamespaceResolver,
+            resolve_term_namespaces,
+        )
+        bare = NamespaceResolver()  # no informer wired
+        for term in (
+                self.ns_term("web", ZONE, {}),
+                self.ns_term("web", ZONE, {"matchLabels": {"team": "a"}}),
+                dict(self.ns_term("web", ZONE,
+                                  {"matchLabels": {"team": "a"}}),
+                     namespaces=["other"]),
+                {"labelSelector": {}, "topologyKey": ZONE},
+        ):
+            assert resolve_term_namespaces(term, "default") == \
+                bare(term, "default")
+        assert resolve_term_namespaces(
+            self.ns_term("w", ZONE, {}), "default") == ALL_NAMESPACES
 
     def test_host_and_tensor_parity_with_ns_selector(self):
         plugin = InterPodAffinity()
@@ -336,3 +370,164 @@ class TestNamespaceSelector:
                     assert bool(row[j]) == host_ok, (
                         f"seed={seed} pod={pi.key} node={ni.name}: "
                         f"tensor={bool(row[j])} host={host_ok}")
+
+
+class TestSpreadDifferential:
+    """Compiled spread primitives vs the host PodTopologySpread plugin:
+    minDomains, namespaceSelector, restricted eligibility, and
+    non-self-matching selectors must agree node-for-node."""
+
+    def _snapshot(self, rng, n_nodes=12):
+        cache = SchedulerCache()
+        for i in range(n_nodes):
+            labels = {ZONE: rng.choice(ZONES)}
+            if rng.random() < 0.7:
+                labels["tier"] = rng.choice(["fast", "slow"])
+            cache.add_node(make_node(f"n{i}", labels=labels))
+            for j in range(rng.randrange(3)):
+                cache.add_pod(PodInfo(make_pod(
+                    f"r-{i}-{j}", labels={"app": rng.choice(APPS)},
+                    node_name=f"n{i}",
+                    namespace=rng.choice(["default", "other"]))))
+        return cache.update_snapshot()
+
+    def _constraint(self, rng):
+        c = {"maxSkew": rng.choice([1, 2]), "topologyKey": ZONE,
+             "whenUnsatisfiable": "DoNotSchedule",
+             "labelSelector": {"matchLabels": {"app": rng.choice(APPS)}}}
+        if rng.random() < 0.4:
+            c["minDomains"] = rng.choice([2, 4, 6])
+        if rng.random() < 0.4:
+            c["namespaceSelector"] = {}
+        return c
+
+    def test_spread_filter_rows_match_host_plugin(self):
+        from kubernetes_tpu.scheduler.plugins.podtopologyspread import (
+            PodTopologySpread,
+        )
+        plugin = PodTopologySpread()
+        for seed in range(6):
+            rng = random.Random(2000 + seed)
+            snapshot = self._snapshot(rng)
+            compiler = AffinityCompiler(snapshot, n_pad=16)
+            for k in range(6):
+                cons = [self._constraint(rng)
+                        for _ in range(rng.choice([1, 2]))]
+                pod = PodInfo(make_pod(
+                    f"pend-{seed}-{k}", labels={"app": rng.choice(APPS)},
+                    namespace=rng.choice(["default", "other"]),
+                    node_selector={"tier": "fast"}
+                    if rng.random() < 0.4 else None,
+                    topology_spread_constraints=cons, uid=f"du{seed}{k}"))
+                row = compiler.spread_filter_row(pod, cons)
+                state = CycleState()
+                st = plugin.pre_filter(state, pod, snapshot)
+                for j, ni in enumerate(snapshot.nodes):
+                    host_ok = True if st.is_skip() else \
+                        plugin.filter(state, pod, ni).is_success()
+                    assert bool(row[j]) == host_ok, (
+                        f"seed={seed} pod={pod.key} node={ni.name} "
+                        f"cons={cons}: tensor={bool(row[j])} "
+                        f"host={host_ok}")
+
+    def test_min_domains_deficit_floors_min_to_zero(self):
+        """Fewer eligible domains than minDomains → global min treated 0:
+        a domain at maxSkew matching pods rejects even when another
+        domain is emptier (host plugin and compiled row agree)."""
+        from kubernetes_tpu.scheduler.plugins.podtopologyspread import (
+            PodTopologySpread,
+        )
+        cache = SchedulerCache()
+        for i, z in enumerate(["z1", "z1", "z2"]):
+            cache.add_node(make_node(f"n{i}", labels={ZONE: z}))
+        for j in range(2):  # z1 already holds 2 matching pods
+            cache.add_pod(PodInfo(make_pod(
+                f"r{j}", labels={"app": "m"}, node_name=f"n{j % 2}")))
+        snapshot = cache.update_snapshot()
+        cons = [{"maxSkew": 2, "topologyKey": ZONE,
+                 "whenUnsatisfiable": "DoNotSchedule", "minDomains": 3,
+                 "labelSelector": {"matchLabels": {"app": "m"}}}]
+        pod = PodInfo(make_pod("p", labels={"app": "m"},
+                               topology_spread_constraints=cons, uid="u"))
+        plugin = PodTopologySpread()
+        compiler = AffinityCompiler(snapshot, n_pad=8)
+        row = compiler.spread_filter_row(pod, cons)
+        state = CycleState()
+        plugin.pre_filter(state, pod, snapshot)
+        expect = [False, False, True]  # z1 at 2+1-0 > 2; z2 at 0+1-0 ≤ 2
+        for j, ni in enumerate(snapshot.nodes):
+            host_ok = plugin.filter(state, pod, ni).is_success()
+            assert host_ok == expect[j]
+            assert bool(row[j]) == expect[j]
+
+
+class TestScoreDifferential:
+    """Compiled score paths vs the host plugins — the namespaceSelector
+    host-score fallback is gone (score_supported is always True), so the
+    compiled rows need their own parity coverage."""
+
+    def test_ipa_score_row_matches_host_plugin_with_ns_selector(self):
+        plugin = InterPodAffinity({"hardPodAffinityWeight": 3})
+        plugin.ns_resolver = resolver_for(
+            TestNamespaceSelector.NAMESPACES)
+        for seed in range(4):
+            rng = random.Random(3000 + seed)
+            snapshot = random_affinity_cluster(rng)
+            compiler = AffinityCompiler(
+                snapshot, n_pad=32, ns_resolver=plugin.ns_resolver)
+            feasible = np.zeros((32,), dtype=np.bool_)
+            feasible[: len(snapshot.nodes)] = True
+            for k in range(6):
+                sel = rng.choice([
+                    {"matchLabels": {"team": "a"}}, {}, None])
+                t = {"labelSelector":
+                     {"matchLabels": {"app": rng.choice(APPS)}},
+                     "topologyKey": rng.choice([HOSTNAME, ZONE])}
+                if sel is not None:
+                    t["namespaceSelector"] = sel
+                pod = PodInfo(make_pod(
+                    f"sc-{seed}-{k}", labels={"app": rng.choice(APPS)},
+                    namespace=rng.choice(["default", "other"]),
+                    affinity={"podAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution":
+                        [{"weight": rng.choice([1, 50]),
+                          "podAffinityTerm": t}]}}, uid=f"sc{seed}{k}"))
+                row = compiler.score_row(pod, 3.0, feasible)
+                state = CycleState()
+                st = plugin.pre_score(state, pod, list(snapshot.nodes))
+                for j, ni in enumerate(snapshot.nodes):
+                    host = 0.0 if st.is_skip() else \
+                        plugin.score(state, pod, ni)
+                    assert abs(float(row[j]) - host) < 1e-4, (
+                        f"seed={seed} k={k} node={ni.name}: "
+                        f"tensor={float(row[j])} host={host}")
+
+    def test_spread_raw_scores_match_host_plugin_with_ns_selector(self):
+        from kubernetes_tpu.scheduler.plugins.podtopologyspread import (
+            PodTopologySpread,
+        )
+        plugin = PodTopologySpread()
+        for seed in range(4):
+            rng = random.Random(4000 + seed)
+            snapshot = random_affinity_cluster(rng, n_nodes=10)
+            compiler = AffinityCompiler(snapshot, n_pad=16)
+            for k in range(4):
+                cons = [{"maxSkew": 1, "topologyKey": ZONE,
+                         "whenUnsatisfiable": "ScheduleAnyway",
+                         "labelSelector":
+                         {"matchLabels": {"app": rng.choice(APPS)}}}]
+                if rng.random() < 0.5:
+                    cons[0]["namespaceSelector"] = {}
+                pod = PodInfo(make_pod(
+                    f"sp-{seed}-{k}", labels={"app": rng.choice(APPS)},
+                    namespace=rng.choice(["default", "other"]),
+                    topology_spread_constraints=cons, uid=f"sp{seed}{k}"))
+                raw = compiler.spread_raw_scores(pod, cons)
+                state = CycleState()
+                st = plugin.pre_score(state, pod, list(snapshot.nodes))
+                for j, ni in enumerate(snapshot.nodes):
+                    host = 0.0 if st.is_skip() else \
+                        plugin.score(state, pod, ni)
+                    assert abs(float(raw[j]) - host) < 1e-4, (
+                        f"seed={seed} k={k} node={ni.name}: "
+                        f"tensor={float(raw[j])} host={host}")

@@ -209,9 +209,9 @@ class TestProfilerHook:
 
 class TestBackendDegradationMetrics:
     """§5.5: the TPU backend's silent fallbacks are observable — spread
-    template poisoning here; gang overflow in test_coscheduling."""
+    residency here; gang overflow in test_coscheduling."""
 
-    def test_spread_poisoning_increments_counter(self):
+    def test_heterogeneous_min_domains_batch_stays_on_device(self):
         async def body():
             import asyncio
 
@@ -245,9 +245,10 @@ class TestBackendDegradationMetrics:
                     c.update(extra)
                 return make_pod(name, labels={"app": app},
                                 topology_spread_constraints=[c])
-            # Heterogeneous templates now ride the UNION table; only a
-            # template the tensors can't model (minDomains here) falls
-            # back to host rows and fires the degradation counter.
+            # EVERY template rides the union table now — heterogeneous
+            # batches and minDomains constraints included. The
+            # spread_poisoned counter marks only the missing-table escape
+            # hatch and must stay ZERO here.
             for i in range(4):
                 await store.create("pods", spread_pod(f"a{i}", "a", 1))
                 await store.create("pods", spread_pod(
@@ -257,8 +258,10 @@ class TestBackendDegradationMetrics:
                 if sum(1 for p in pods if p["spec"].get("nodeName")) == 8:
                     break
                 await asyncio.sleep(0.02)
+            pods = (await store.list("pods")).items
+            assert sum(1 for p in pods if p["spec"].get("nodeName")) == 8
             assert sched.metrics.backend_degradations.value(
-                kind="spread_poisoned") >= 1
+                kind="spread_poisoned") == 0
             await sched.stop()
             run_task.cancel()
             factory.stop()

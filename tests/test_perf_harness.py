@@ -165,3 +165,25 @@ class TestNewFamilies:
             backend=TPUBackend(max_batch=8), batch_size=8).run(
             template, {}, timeout=60.0))
         assert res.measured_pods == 4
+
+
+class TestAgentBackedStaging:
+    def test_start_agents_opcode(self):
+        """startAgents boots N in-process NodeAgents: they register their
+        own Nodes, consume field-selector pod watches, and mark bound
+        pods Running — kwok-free staging (the AgentBackedBasic family)."""
+        template = [
+            {"opcode": "startAgents", "count": 5},
+            {"opcode": "createPods", "count": 20, "collectMetrics": True},
+            {"opcode": "barrier"},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {}, timeout=60.0))
+        assert res.scheduled_total >= 20
+        assert res.throughput > 0
+
+    def test_repo_config_has_agent_family(self):
+        from kubernetes_tpu.perf.scheduler_perf import load_config
+        cfg = load_config(
+            "kubernetes_tpu/perf/config/performance-config.yaml")
+        fam = next(c for c in cfg if c["name"] == "AgentBackedBasic")
+        assert fam["workloadTemplate"][0]["opcode"] == "startAgents"
