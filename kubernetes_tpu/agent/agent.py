@@ -40,6 +40,7 @@ from kubernetes_tpu.api.types import (
 from kubernetes_tpu.agent.ledger import DeviceLedger
 from kubernetes_tpu.store.mvcc import (
     AlreadyExists,
+    Conflict,
     Expired,
     NotFound,
     StoreError,
@@ -338,9 +339,33 @@ class NodeAgent:
             complete_after[0] = (obj["metadata"].get("annotations")
                                  or {}).get(COMPLETE_AFTER_ANN)
             return obj
+
+        # Fast path: the watch just handed us the pod at its current RV,
+        # so mutate a selective copy of THAT and CAS once on its RV — one
+        # write instead of guaranteed_update's GET+PUT. After Bind nobody
+        # else writes the pod, so the CAS nearly always lands; a Conflict
+        # (racing controller, stale delivery) falls back to the full RMW
+        # loop. Copies only the containers touched (binding_subresource's
+        # selective-copy discipline — delivered objects are shared/frozen;
+        # spec + tolerations are included because update-time admission
+        # defaulting calls setdefault on them).
+        spec = dict(pod.get("spec") or {})
+        spec["tolerations"] = list(spec.get("tolerations") or [])
+        fast = {**pod, "metadata": dict(pod["metadata"]), "spec": spec,
+                "status": dict(pod.get("status") or {})}
+        fast["status"]["conditions"] = [
+            dict(c) for c in fast["status"].get("conditions") or []]
         try:
-            await self.store.guaranteed_update(
-                "pods", key, mutate, return_copy=False)
+            if mutate(fast) is not None:
+                await self.store.update("pods", fast, _owned=True,
+                                        return_copy=False)
+        except Conflict:
+            complete_after[0] = None
+            try:
+                await self.store.guaranteed_update(
+                    "pods", key, mutate, return_copy=False)
+            except StoreError:
+                return
         except StoreError:
             return
         if complete_after[0] is not None:
@@ -376,25 +401,46 @@ class NodeAgent:
     # -- heartbeats --------------------------------------------------------
 
     async def _lease_loop(self) -> None:
+        """Heartbeats as exact-key latest-wins writes: the agent is its
+        Lease's only writer, so after the first fetch seeds the local
+        copy, each renewal is ONE blind update (no RV precondition, no
+        read-modify-write GET) — at 1,000 agents this halves the ~200
+        heartbeat ops/s riding the control plane. Any surprise (deleted
+        lease, transport error) just drops the local copy and re-seeds."""
+        key = f"kube-node-lease/{self.node_name}"
+        lease: dict | None = None
         while not self._stopped:
             try:
-                await self.store.guaranteed_update(
-                    "leases", f"kube-node-lease/{self.node_name}",
-                    self._renew)
-            except NotFound:
-                lease = new_object("Lease", self.node_name,
-                                   "kube-node-lease",
-                                   spec={"renewTime": 0})
-                try:
-                    await self.store.create("leases", lease)
-                except StoreError:
-                    pass
+                if lease is None:
+                    lease = await self._fetch_or_create_lease(key)
+                if lease is not None:
+                    self._renew(lease)
+                    lease["metadata"].pop("resourceVersion", None)
+                    lease = await self.store.update("leases", lease)
             except asyncio.CancelledError:
                 raise
+            except NotFound:
+                lease = None  # deleted under us: re-seed next tick
             except Exception:
                 logger.exception("agent %s: lease renew failed",
                                  self.node_name)
+                lease = None
             await asyncio.sleep(self.lease_period)
+
+    async def _fetch_or_create_lease(self, key: str) -> dict | None:
+        try:
+            return await self.store.get("leases", key)
+        except NotFound:
+            pass
+        try:
+            return await self.store.create(
+                "leases", new_object("Lease", self.node_name,
+                                     "kube-node-lease",
+                                     spec={"renewTime": 0}))
+        except AlreadyExists:
+            return await self.store.get("leases", key)
+        except StoreError:
+            return None
 
     @staticmethod
     def _renew(lease: dict) -> dict:

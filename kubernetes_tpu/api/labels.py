@@ -164,6 +164,30 @@ def parse_selector(s: str) -> Selector:
     return Selector(reqs)
 
 
+def parse_field_selector(s: str) -> dict[str, str]:
+    """Parse the `fieldSelector` query grammar: "spec.nodeName=n0,
+    status.phase=Running" (fields.ParseSelector — the apiserver supports
+    only exact-match terms, which is also what the store's tracked-field
+    index serves)."""
+    fields: dict[str, str] = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r"^([\w./-]+)\s*==?\s*([^,]*)$", part)
+        if m is None:
+            raise ValueError(f"cannot parse field selector clause {part!r}")
+        fields[m.group(1)] = m.group(2).strip()
+    return fields
+
+
+def field_selector_to_string(fields: Mapping[str, str] | None) -> str:
+    """Serialize a field map back to the query grammar."""
+    if not fields:
+        return ""
+    return ",".join(f"{k}={v}" for k, v in sorted(fields.items()))
+
+
 def selector_to_string(sel: Selector | None) -> str:
     """Serialize a Selector back to the string grammar parse_selector reads
     (the `labelSelector` query-parameter wire form)."""

@@ -23,7 +23,11 @@ from typing import AsyncIterator, Callable, Mapping
 
 import aiohttp
 
-from kubernetes_tpu.api.labels import Selector, selector_to_string
+from kubernetes_tpu.api.labels import (
+    Selector,
+    field_selector_to_string,
+    selector_to_string,
+)
 from kubernetes_tpu.api.meta import namespaced_name
 from kubernetes_tpu.store.mvcc import (
     AlreadyExists,
@@ -254,11 +258,15 @@ class RemoteStore:
         self, resource: str, namespace: str | None = None,
         selector: Selector | None = None, limit: int = 0,
         continue_key: str | None = None,
+        fields: Mapping[str, str] | None = None,
     ) -> ListResult:
         params = {}
         sel = selector_to_string(selector)
         if sel:
             params["labelSelector"] = sel
+        fs = field_selector_to_string(fields)
+        if fs:
+            params["fieldSelector"] = fs
         if limit:
             params["limit"] = str(limit)
         if continue_key:
@@ -275,6 +283,7 @@ class RemoteStore:
     async def watch(
         self, resource: str, resource_version: int = 0,
         namespace: str | None = None, selector: Selector | None = None,
+        fields: Mapping[str, str] | None = None,
         **_kw,
     ) -> AsyncIterator[Event]:
         params = {"watch": "1"}
@@ -283,6 +292,9 @@ class RemoteStore:
         sel = selector_to_string(selector)
         if sel:
             params["labelSelector"] = sel
+        fs = field_selector_to_string(fields)
+        if fs:
+            params["fieldSelector"] = fs
         resp = await self._sess().get(
             self._collection_url(resource, namespace), params=params,
             timeout=aiohttp.ClientTimeout(total=None, sock_read=None))

@@ -29,7 +29,7 @@ from typing import Mapping
 
 from aiohttp import web
 
-from kubernetes_tpu.api.labels import parse_selector
+from kubernetes_tpu.api.labels import parse_field_selector, parse_selector
 from kubernetes_tpu.store.mvcc import (
     AlreadyExists,
     Conflict,
@@ -238,6 +238,10 @@ class APIServer:
         #: mutating/validating webhook out-calls.
         self.admission = admission
         self.metrics_registry = metrics_registry
+        if metrics_registry is not None:
+            # Watch-dispatch counters live on the store (it owns dispatch);
+            # surface them through this server's /metrics exposition.
+            store.watch_metrics.register_into(metrics_registry)
         self.audit_log = audit_log
         #: OTel-style request spans (SURVEY §5.1); defaults to the
         #: process tracer, which is disabled unless someone enables it.
@@ -609,11 +613,15 @@ class APIServer:
             sel = None
             if request.query.get("labelSelector"):
                 sel = parse_selector(request.query["labelSelector"])
+            fields = None
+            if request.query.get("fieldSelector"):
+                fields = parse_field_selector(
+                    request.query["fieldSelector"])
             limit = int(request.query.get("limit", 0) or 0)
             cont = request.query.get("continue")
             lst = await self.store.list(
                 resource, namespace=request["namespace"], selector=sel,
-                limit=limit, continue_key=cont)
+                limit=limit, continue_key=cont, fields=fields)
             body = {
                 "kind": "List", "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(lst.resource_version)},
@@ -750,10 +758,17 @@ class APIServer:
         sel = None
         if request.query.get("labelSelector"):
             sel = parse_selector(request.query["labelSelector"])
+        fields = None
+        if request.query.get("fieldSelector"):
+            # The kubelet's watch shape (spec.nodeName=<me>): exact-match
+            # field terms ride the store's tracked-field index, so this
+            # wire's fan-out is O(matching watchers) too.
+            fields = parse_field_selector(request.query["fieldSelector"])
         try:
             watch = await self.store.watch(
                 resource, resource_version=rv,
-                namespace=request["namespace"], selector=sel)
+                namespace=request["namespace"], selector=sel,
+                fields=fields)
         except Expired as e:
             return _error_response(e)
         resp = web.StreamResponse(
@@ -769,7 +784,11 @@ class APIServer:
                              + b'"}}}\n')
                 else:
                     # Spliced frame: object bytes encoded once per event
-                    # across every watcher (HTTP and wire — SURVEY §3.2).
+                    # ACROSS its synthesized twins too (encode_event_object
+                    # follows _wire_src — SURVEY §3.2). The splice itself
+                    # stays per-connection: memoizing the whole frame would
+                    # pin a second full copy of every object on events
+                    # retained in the 200k-entry history window.
                     frame = (b'{"type":"' + ev.type.encode()
                              + b'","object":' + encode_event_object(ev)
                              + b'}\n')

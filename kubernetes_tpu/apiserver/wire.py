@@ -116,32 +116,47 @@ def _reason_for(exc: StoreError) -> str:
     return "InternalError"
 
 
-def encode_event_object(ev: Event) -> bytes:
-    """JSON-encode a watch event's object ONCE per event, shared across
-    every watcher (HTTP and wire): the store delivers the same Event
-    instance to all channels, so the bytes memoize on it (SURVEY §3.2 —
-    the reference cacher serializes once per event, not per watcher)."""
-    b = getattr(ev, "_wire_obj", None)
+def _encode_memo(ev: Event, attr: str, encode) -> bytes:
+    """Per-codec encode-once across an event AND its synthesized
+    enter/leave twins: the store delivers the same Event instance to all
+    channels of a selector group, and a twin links its source via
+    `_wire_src` (store/mvcc.py `_synth`) — they share one object, so
+    they share one encoding. The memo is read from/written to both ends
+    of the link, so whichever watcher encodes first pays for everyone
+    (SURVEY §3.2 — the reference cacher serializes once per event, not
+    per watcher)."""
+    b = getattr(ev, attr, None)
+    if b is not None:
+        return b
+    src = getattr(ev, "_wire_src", None)
+    if src is not None:
+        b = getattr(src, attr, None)
     if b is None:
-        b = _dumps(ev.object, separators=(",", ":")).encode()
-        try:
-            ev._wire_obj = b
-        except AttributeError:  # frozen/slots object: still correct, no memo
-            pass
+        b = encode(ev.object)
+        if src is not None:
+            try:
+                setattr(src, attr, b)
+            except AttributeError:
+                pass
+    try:
+        setattr(ev, attr, b)
+    except AttributeError:  # frozen/slots object: still correct, no memo
+        pass
     return b
+
+
+def encode_event_object(ev: Event) -> bytes:
+    """JSON-encode a watch event's object once per event (+ twins),
+    shared across every watcher on both wires."""
+    return _encode_memo(
+        ev, "_wire_obj",
+        lambda obj: _dumps(obj, separators=(",", ":")).encode())
 
 
 def encode_event_object_mp(ev: Event) -> bytes:
     """msgpack twin of encode_event_object — one packing per event
     shared across every msgpack watcher."""
-    b = getattr(ev, "_wire_obj_mp", None)
-    if b is None:
-        b = _packb(ev.object)
-        try:
-            ev._wire_obj_mp = b
-        except AttributeError:
-            pass
-    return b
+    return _encode_memo(ev, "_wire_obj_mp", _packb)
 
 
 class _Conn(asyncio.Protocol):

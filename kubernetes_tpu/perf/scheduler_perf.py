@@ -87,6 +87,13 @@ class WorkloadResult:
         #: stderr warning.
         self.host_fallback_pods = 0
         self.spread_poisoned_pods = 0
+        #: Watch-dispatch efficiency over the measured phase (the store's
+        #: interned selector index — metrics/registry.py WatchMetrics):
+        #: deliveries vs predicate evaluations. checks staying O(events)
+        #: while watcher count grows is the index working; a regression
+        #: to O(events × watchers) shows up here as data.
+        self.watch_events_dispatched_total = 0
+        self.watch_predicate_checks_total = 0
 
     def as_dict(self) -> dict:
         import math
@@ -111,6 +118,10 @@ class WorkloadResult:
             if self.events_emitted_total else 0.0,
             "host_fallback_pods": self.host_fallback_pods,
             "spread_poisoned_pods": self.spread_poisoned_pods,
+            "watch_events_dispatched_total":
+                self.watch_events_dispatched_total,
+            "watch_predicate_checks_total":
+                self.watch_predicate_checks_total,
         }
 
 
@@ -337,7 +348,7 @@ class PerfRunner:
                         # Metric window starts now: percentiles and
                         # throughput cover only the measured phase (warmup
                         # attempts — including jit compile — are excluded).
-                        window = self._begin_measure(metrics)
+                        window = self._begin_measure(metrics, backing)
                         if self.profile_dir and hasattr(
                                 self.backend, "start_profile"):
                             self.backend.start_profile(self.profile_dir)
@@ -396,7 +407,8 @@ class PerfRunner:
                         pod_ns = tmpl.get("namespace", "default")
                         want = {f"{pod_ns}/{n}" for n in names}
                         await self._wait_keys(bound_keys, want, deadline)
-                        self._end_measure(result, metrics, window, count)
+                        self._end_measure(result, metrics, backing,
+                                          window, count)
                         if self.profile_dir and hasattr(
                                 self.backend, "stop_profile"):
                             self.backend.stop_profile()
@@ -408,7 +420,7 @@ class PerfRunner:
                     # times gate-removal → all bound.
                     measured = bool(op.get("collectMetrics"))
                     if measured:
-                        window = self._begin_measure(metrics)
+                        window = self._begin_measure(metrics, backing)
                     gated = [p for p in (await store.list("pods")).items
                              if p["spec"].get("schedulingGates")]
 
@@ -421,8 +433,8 @@ class PerfRunner:
                     if measured:
                         await self._wait_bound(bound_keys, created_total,
                                                deadline)
-                        self._end_measure(result, metrics, window,
-                                          len(gated))
+                        self._end_measure(result, metrics, backing,
+                                          window, len(gated))
 
                 elif opcode == "barrier":
                     await self._wait_bound(bound_keys, created_total, deadline)
@@ -487,18 +499,22 @@ class PerfRunner:
         return result
 
     @staticmethod
-    def _begin_measure(metrics: SchedulerMetrics) -> tuple:
+    def _begin_measure(metrics: SchedulerMetrics, backing) -> tuple:
         deg = metrics.backend_degradations
+        wm = backing.watch_metrics
         return (metrics.attempt_duration.snapshot(
             result="scheduled", profile="default-scheduler"),
             time.monotonic(),
             deg.value(kind="host_fallback"),
-            deg.value(kind="spread_poisoned"))
+            deg.value(kind="spread_poisoned"),
+            wm.events_dispatched.value(),
+            wm.predicate_checks.value())
 
     @staticmethod
     def _end_measure(result: WorkloadResult, metrics: SchedulerMetrics,
-                     window: tuple, count: int) -> None:
-        hist_base, t0, fallback_base, poisoned_base = window
+                     backing, window: tuple, count: int) -> None:
+        (hist_base, t0, fallback_base, poisoned_base,
+         dispatched_base, checks_base) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
         result.measured_seconds = dt
@@ -513,6 +529,11 @@ class PerfRunner:
             deg.value(kind="host_fallback") - fallback_base)
         result.spread_poisoned_pods = int(
             deg.value(kind="spread_poisoned") - poisoned_base)
+        wm = backing.watch_metrics
+        result.watch_events_dispatched_total = int(
+            wm.events_dispatched.value() - dispatched_base)
+        result.watch_predicate_checks_total = int(
+            wm.predicate_checks.value() - checks_base)
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

@@ -1,0 +1,85 @@
+"""EventRecorder: bounded broadcaster + per-(object, reason) aggregation
+(the upstream EventCorrelator/EventAggregator analog)."""
+
+import asyncio
+
+from kubernetes_tpu.client.events import EventRecorder
+from kubernetes_tpu.store.mvcc import MVCCStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _pod(name):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"}}
+
+
+class TestAggregation:
+    def test_repeat_same_object_reason_bumps_count(self):
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            for _ in range(5):
+                rec.event(_pod("a"), "Warning", "FailedScheduling",
+                          "0/3 nodes available")
+            rec.event(_pod("a"), "Normal", "Scheduled", "bound")
+            rec.event(_pod("b"), "Warning", "FailedScheduling", "nope")
+            # 7 calls → 3 distinct (object, type, reason) Events pending.
+            assert rec.emitted == 7
+            assert rec.aggregated == 4
+            assert rec.dropped == 0
+            await asyncio.sleep(0.05)  # drain
+            evs = (await s.list("events")).items
+            assert len(evs) == 3
+            failed_a = [e for e in evs
+                        if e["reason"] == "FailedScheduling"
+                        and e["involvedObject"]["name"] == "a"]
+            assert len(failed_a) == 1
+            assert failed_a[0]["count"] == 5
+            assert failed_a[0]["lastTimestamp"]
+        run(body())
+
+    def test_aggregation_is_buffer_local(self):
+        """Once drained, a recurrence starts a fresh Event (we do not
+        PATCH stored events, unlike the full upstream correlator)."""
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            rec.event(_pod("a"), "Warning", "FailedScheduling", "x")
+            await asyncio.sleep(0.05)
+            rec.event(_pod("a"), "Warning", "FailedScheduling", "x")
+            await asyncio.sleep(0.05)
+            evs = (await s.list("events")).items
+            assert len(evs) == 2
+            assert all(e.get("count") == 1 for e in evs)
+        run(body())
+
+    def test_preloop_buffer_flushes_via_aggregated_recurrence(self):
+        """Events recorded before any loop runs must still drain when the
+        next event() under a loop is an aggregated recurrence."""
+        s = MVCCStore()
+        rec = EventRecorder(s, "scheduler")
+        rec.event(_pod("a"), "Warning", "FailedScheduling", "x")  # no loop
+
+        async def body():
+            rec.event(_pod("a"), "Warning", "FailedScheduling", "x")
+            assert rec.aggregated == 1
+            await asyncio.sleep(0.05)
+            evs = (await s.list("events")).items
+            assert len(evs) == 1 and evs[0]["count"] == 2
+        run(body())
+
+    def test_flood_of_distinct_objects_still_bounded(self):
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            # No loop yield between these: the buffer caps the burst.
+            for i in range(3000):
+                rec.event(_pod(f"p{i}"), "Normal", "Scheduled", "bound")
+            assert rec.dropped == 3000 - rec.MAX_PENDING
+            await asyncio.sleep(0.2)
+            evs = (await s.list("events")).items
+            assert len(evs) == rec.MAX_PENDING
+        run(body())
