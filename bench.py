@@ -66,6 +66,18 @@ def main(argv=None) -> int:
                          "'wire' = the multiplexed framed wire core "
                          "components use (the reference's HTTP/2+protobuf "
                          "analog); 'http' = per-request HTTP/1.1+JSON")
+    ap.add_argument("--policy-set", type=int, default=0,
+                    help="install N ValidatingAdmissionPolicies (+ "
+                         "bindings) matching pod CREATEs before the "
+                         "run — the policy-chain overhead knob "
+                         "(BASELINE r9 measures 10 vs 0). Counted in "
+                         "the detail JSON's policy_evaluations_total")
+    ap.add_argument("--audit-level", default="",
+                    choices=["", "Metadata", "Request",
+                             "RequestResponse"],
+                    help="enable the audit pipeline at this level for "
+                         "every request (default: no audit rules = "
+                         "level None, zero cost)")
     ap.add_argument("--profile-dir", default="",
                     help="write a jax.profiler device trace of the "
                          "MEASURED phase to this directory (tpu backend "
@@ -120,9 +132,18 @@ def main(argv=None) -> int:
     boundary = False
     if args.through_apiserver:
         boundary = "wire" if args.transport == "wire" else True
+    elif args.policy_set or args.audit_level:
+        # The policy chain lives on the servers: without the boundary
+        # these flags measure nothing — refuse to record a lie.
+        print("warning: --policy-set/--audit-level need "
+              "--through-apiserver; the run will evaluate NO policies",
+              file=sys.stderr)
     runner = PerfRunner(backend=backend, batch_size=batch,
                         through_apiserver=boundary,
-                        profile_dir=args.profile_dir or None)
+                        profile_dir=args.profile_dir or None,
+                        policy_count=args.policy_set,
+                        audit_rules=[{"level": args.audit_level}]
+                        if args.audit_level else None)
     res = asyncio.run(runner.run(template, params, timeout=1800.0))
 
     detail = res.as_dict()

@@ -156,11 +156,16 @@ KIND_TO_RESOURCE = {
     "ServiceAccount": "serviceaccounts",
     "Secret": "secrets",
     "VolumeAttachment": "volumeattachments",
+    "ConfigMap": "configmaps",
+    # admissionregistration.k8s.io expression policies (policy/vap.py).
+    "ValidatingAdmissionPolicy": "validatingadmissionpolicies",
+    "ValidatingAdmissionPolicyBinding": "validatingadmissionpolicybindings",
 }
 
 #: resources without a namespace segment in their keys/URLs.
 CLUSTER_SCOPED_RESOURCES = {
     "nodes", "namespaces", "persistentvolumes", "storageclasses",
     "noderesourcetopologies", "deviceclasses", "resourceslices",
-    "volumeattachments",
+    "volumeattachments", "validatingadmissionpolicies",
+    "validatingadmissionpolicybindings",
 }

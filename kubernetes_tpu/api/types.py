@@ -300,6 +300,50 @@ def make_namespace(name: str) -> dict:
     return new_object("Namespace", name, None, status={"phase": "Active"})
 
 
+def make_validating_admission_policy(
+        name: str,
+        validations: list[Mapping],
+        *,
+        failure_policy: str = "Fail",
+        param_kind: str | None = None,
+        match_constraints: Mapping | None = None) -> dict:
+    """admissionregistration.k8s.io/v1 ValidatingAdmissionPolicy
+    (policy/vap.py). `validations` entries: {"expression": ...,
+    "message": ...}; `match_constraints` carries resourceRules /
+    namespaceSelector. Inert until a binding references it."""
+    spec: dict[str, Any] = {
+        "failurePolicy": failure_policy,
+        "validations": [dict(v) for v in validations],
+    }
+    if param_kind:
+        spec["paramKind"] = {"kind": param_kind}
+    if match_constraints is not None:
+        spec["matchConstraints"] = dict(match_constraints)
+    return new_object("ValidatingAdmissionPolicy", name, None,
+                      api_version="admissionregistration.k8s.io/v1",
+                      spec=spec)
+
+
+def make_vap_binding(name: str, policy_name: str, *,
+                     param_ref: Mapping | None = None) -> dict:
+    """ValidatingAdmissionPolicyBinding: activates a policy; `param_ref`
+    ({"name": ..., "namespace": ...}) resolves against the policy's
+    paramKind."""
+    spec: dict[str, Any] = {"policyName": policy_name}
+    if param_ref is not None:
+        spec["paramRef"] = dict(param_ref)
+    return new_object("ValidatingAdmissionPolicyBinding", name, None,
+                      api_version="admissionregistration.k8s.io/v1",
+                      spec=spec)
+
+
+def make_config_map(name: str, namespace: str = "default",
+                    data: Mapping[str, Any] | None = None) -> dict:
+    """core/v1 ConfigMap — the usual VAP paramKind."""
+    return new_object("ConfigMap", name, namespace,
+                      data=dict(data or {}))
+
+
 def make_binding(pod: Mapping, node_name: str) -> dict:
     """core/v1 Binding: target node for a pod; POSTed to the pod's /binding
     subresource (pkg/registry/core/pod/storage `BindingREST.Create`)."""

@@ -79,11 +79,17 @@ def _rules_match(webhook: Mapping, resource: str, operation: str) -> bool:
 
 
 class WebhookAdmission:
-    """Runs the configured webhook chain for one (object, op, resource)."""
+    """Runs the admission chain for one (object, op, resource): mutating
+    webhooks → ValidatingAdmissionPolicy expressions (policy/vap.py,
+    when a PolicyEngine is attached) → validating webhooks — the
+    reference plugin order (VAP sorts before ValidatingAdmissionWebhook
+    in pkg/kubeapiserver/options/plugins.go)."""
 
-    def __init__(self, store, timeout: float = 5.0):
+    def __init__(self, store, timeout: float = 5.0, policy_engine=None):
         self.store = store
         self.timeout = timeout
+        #: policy/vap.PolicyEngine or None = no expression policies.
+        self.policy_engine = policy_engine
         self._session = None
 
     async def _post(self, url: str, review: dict) -> dict:
@@ -106,11 +112,14 @@ class WebhookAdmission:
     def _configs(self, table: str) -> list[dict]:
         return list(self.store._table(table).values())
 
-    async def admit(self, obj: dict, resource: str,
-                    operation: str) -> dict:
-        """Mutating chain (patches applied in order), then validating
-        chain. Raises Invalid on deny; failurePolicy Fail treats an
-        unreachable webhook as deny, Ignore (default here) skips it."""
+    async def admit(self, obj: dict, resource: str, operation: str, *,
+                    user: str | None = None,
+                    groups: list[str] | None = None) -> dict:
+        """Mutating chain (patches applied in order), then the
+        ValidatingAdmissionPolicy stage, then the validating chain.
+        Raises Invalid on deny; failurePolicy Fail treats an unreachable
+        webhook as deny, Ignore (default here) skips it. `user`/`groups`
+        feed the policy expressions' `request.userInfo`."""
         for cfg in self._configs("mutatingwebhookconfigurations"):
             for wh in cfg.get("webhooks") or []:
                 if not _rules_match(wh, resource, operation):
@@ -137,6 +146,18 @@ class WebhookAdmission:
                         logger.warning(
                             "ignoring invalid patch from webhook %s: %s",
                             wh.get("name"), e)
+        if self.policy_engine is not None and operation != "delete":
+            # Expression policies see the POST-mutation object; the
+            # stored current object rides as oldObject on updates (the
+            # reference passes the existing object from storage).
+            old = None
+            if operation == "update":
+                from kubernetes_tpu.api.meta import namespaced_name
+                old = self.store._table(resource).get(
+                    namespaced_name(obj))
+            self.policy_engine.validate(
+                obj, resource, operation, old_object=old,
+                user=user, groups=groups)
         for cfg in self._configs("validatingwebhookconfigurations"):
             for wh in cfg.get("webhooks") or []:
                 if not _rules_match(wh, resource, operation):

@@ -75,9 +75,13 @@ class RemoteStore:
 
     def __init__(self, base_url: str, *, token: str | None = None,
                  user_agent: str = "kubernetes-tpu-client",
-                 protobuf: bool = False):
+                 protobuf: bool = False, impersonate: str | None = None):
         self.base_url = base_url.rstrip("/")
         self._headers = {"User-Agent": user_agent}
+        if impersonate:
+            # client-go ImpersonationConfig: every request asks the server
+            # to run as this user (RBAC `impersonate` verb gates it).
+            self._headers["Impersonate-User"] = impersonate
         #: Negotiate the runtime.Unknown protobuf envelope for single
         #: objects (the reference's application/vnd.kubernetes.protobuf
         #: wire between core components); lists/watches stay JSON.
@@ -236,6 +240,24 @@ class RemoteStore:
         async with self._sess().post(
                 url, json=dict(body),
                 headers=self._trace_headers()) as resp:
+            return await self._json(resp)
+
+    async def patch(self, resource: str, key: str, patch: Mapping, *,
+                    patch_type: str = "strategic") -> dict:
+        """kubectl patch: strategic-merge (default), merge, or json patch
+        — the server merges against the live object and the result flows
+        through its full admission chain (webhooks + policies)."""
+        ct = {
+            "strategic": "application/strategic-merge-patch+json",
+            "merge": "application/merge-patch+json",
+            "json": "application/json-patch+json",
+        }.get(patch_type)
+        if ct is None:
+            raise ValueError(f"unknown patch type {patch_type!r}")
+        headers = {"Content-Type": ct, **(self._trace_headers() or {})}
+        async with self._sess().patch(
+                self._item_url(resource, key),
+                data=json.dumps(patch), headers=headers) as resp:
             return await self._json(resp)
 
     async def apply(self, resource: str, obj: Mapping, *,

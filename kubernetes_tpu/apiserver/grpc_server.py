@@ -16,10 +16,12 @@ run over it unchanged, like the HTTP RemoteStore.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import logging
 import sys
 from pathlib import Path
+from typing import Mapping
 
 import grpc
 
@@ -74,11 +76,29 @@ def _abort_code(e: StoreError) -> grpc.StatusCode:
     return grpc.StatusCode.INTERNAL
 
 
-class StoreService:
-    """grpc.aio service over one MVCCStore."""
+#: (user, groups) of the current RPC, set by AuthInterceptor's wrapped
+#: handler in the same task context the service method runs in — how the
+#: admission chain learns the caller identity without widening the
+#: service signatures.
+_CALLER: contextvars.ContextVar = contextvars.ContextVar(
+    "ktpu_grpc_caller", default=None)
 
-    def __init__(self, store: MVCCStore):
+
+class StoreService:
+    """grpc.aio service over one MVCCStore. With an admission chain
+    attached, writes run mutating webhooks → expression policies →
+    validating webhooks exactly like the HTTP and KTPU wires."""
+
+    def __init__(self, store: MVCCStore, admission=None):
         self.store = store
+        self.admission = admission
+
+    async def _admit(self, obj: dict, resource: str, op: str) -> dict:
+        if self.admission is None:
+            return obj
+        caller = _CALLER.get() or ("system:anonymous", [])
+        return await self.admission.admit(
+            obj, resource, op, user=caller[0], groups=caller[1])
 
     async def Get(self, request, context):
         try:
@@ -104,22 +124,28 @@ class StoreService:
 
     async def Create(self, request, context):
         try:
-            obj = await self.store.create(
-                request.resource, _unwrap(request.object))
+            obj = await self._admit(
+                _unwrap(request.object), request.resource, "create")
+            obj = await self.store.create(request.resource, obj)
         except StoreError as e:
             await context.abort(_abort_code(e), str(e))
         return _wrap(obj)
 
     async def Update(self, request, context):
         try:
-            obj = await self.store.update(
-                request.resource, _unwrap(request.object))
+            obj = await self._admit(
+                _unwrap(request.object), request.resource, "update")
+            obj = await self.store.update(request.resource, obj)
         except StoreError as e:
             await context.abort(_abort_code(e), str(e))
         return _wrap(obj)
 
     async def Delete(self, request, context):
         try:
+            if self.admission is not None:
+                current = await self.store.get(
+                    request.resource, request.key)
+                await self._admit(current, request.resource, "delete")
             obj = await self.store.delete(
                 request.resource, request.key, uid=request.uid or None)
         except StoreError as e:
@@ -151,6 +177,186 @@ class StoreService:
             await context.abort(_abort_code(e), str(e))
 
 
+_VERB_OF_METHOD = {"Get": "get", "List": "list", "Create": "create",
+                   "Update": "update", "Delete": "delete",
+                   "Subresource": "update", "Watch": "watch"}
+
+
+class AuthInterceptor(grpc.aio.ServerInterceptor):
+    """The gRPC analog of the apiserver handler chain (§3.2): authn
+    (authorization metadata) → audit stage events → impersonation
+    (impersonate-user metadata, RBAC `impersonate`-gated) → authz →
+    service method. Wraps the resolved method handler so the audit
+    events see the DESERIALIZED request (resource/key) and the final
+    status code."""
+
+    def __init__(self, owner: "GRPCAPIServer"):
+        self.owner = owner
+
+    def _authn(self, md: Mapping) -> str | None:
+        owner = self.owner
+        auth = md.get("authorization", "")
+        if auth.startswith("Bearer ") and owner.bearer_tokens is not None:
+            user = owner.bearer_tokens.get(auth[len("Bearer "):])
+            if user is None:
+                return None  # invalid token → UNAUTHENTICATED
+            return user
+        return "system:anonymous"
+
+    async def intercept_service(self, continuation, details):
+        handler = await continuation(details)
+        owner = self.owner
+        if handler is None or (owner.bearer_tokens is None
+                               and owner.authorizer is None
+                               and owner.audit is None):
+            return handler  # chain disabled: raw service
+        md = {k: v for k, v in (details.invocation_metadata or ())}
+        method = details.method.rsplit("/", 1)[-1]
+        verb = _VERB_OF_METHOD.get(method, method.lower())
+        auth_user = self._authn(md)
+        target = md.get("impersonate-user") or None
+        fail: tuple[grpc.StatusCode, str] | None = None
+        user = auth_user
+        if auth_user is None:
+            fail = (grpc.StatusCode.UNAUTHENTICATED, "invalid token")
+        elif target:
+            if owner.authorizer is not None and \
+                    not owner.authorizer.allowed(
+                        auth_user, "impersonate", "users",
+                        groups=owner.groups_for(auth_user)):
+                fail = (grpc.StatusCode.PERMISSION_DENIED,
+                        f'user "{auth_user}" cannot impersonate user '
+                        f'"{target}"')
+            else:
+                user = target
+
+        def begin_audit(request):
+            if owner.audit is None:
+                return None
+            resource = getattr(request, "resource", "") or ""
+            if not resource:
+                return None
+            key = getattr(request, "key", "") or ""
+            ns, _, name = key.rpartition("/")
+            # Invalid-token requests still audit (as anonymous): the
+            # denials are exactly what the pipeline exists to record.
+            audit_user = auth_user or "system:anonymous"
+            groups = owner.groups_for(audit_user)
+            rule = owner.audit.policy.rule_for(
+                user=audit_user, groups=groups, verb=verb,
+                resource=resource, namespace=ns or None)
+            if rule is None or rule.get("level", "None") == "None":
+                return None  # unaudited: skip the payload parse below
+            if not name:
+                # Create/Update carry the identity inside the
+                # runtime.Unknown envelope, not a key field — parsed
+                # only for requests the policy actually audits (the
+                # service re-parses via _unwrap; doubling that cost on
+                # every unaudited write would tax the wire's whole
+                # point).
+                unknown = getattr(request, "object", None)
+                if unknown is not None and unknown.raw:
+                    try:
+                        meta = (json.loads(unknown.raw).get("metadata")
+                                or {})
+                        name = meta.get("name", "")
+                        ns = meta.get("namespace", "")
+                    except (ValueError, json.JSONDecodeError):
+                        pass
+            return owner.audit.begin(
+                user=audit_user, groups=groups, verb=verb,
+                resource=resource, namespace=ns or None,
+                name=name or None, rule=rule)
+
+        def end_audit(actx, code: int):
+            if actx is not None:
+                owner.audit.response_complete(
+                    actx, code=code,
+                    impersonated_user=user
+                    if user and user != auth_user else None)
+
+        def check_authz(request) -> str | None:
+            resource = getattr(request, "resource", "") or ""
+            if owner.authorizer is None or not resource:
+                return None
+            if not owner.authorizer.allowed(
+                    user, verb, resource, groups=owner.groups_for(user)):
+                return f'user "{user}" cannot {verb} resource ' \
+                       f'"{resource}"'
+            return None
+
+        if handler.unary_unary is not None:
+            inner = handler.unary_unary
+
+            async def uu(request, context):
+                actx = begin_audit(request)
+                if fail is not None:
+                    # authn/impersonation denials are audited too — the
+                    # HTTP wire records its 401/403s, so must this one.
+                    end_audit(actx, _GRPC_AUDIT_CODE.get(fail[0], 500))
+                    await context.abort(*fail)
+                denied = check_authz(request)
+                if denied is not None:
+                    end_audit(actx, 403)
+                    await context.abort(
+                        grpc.StatusCode.PERMISSION_DENIED, denied)
+                token = _CALLER.set((user, owner.groups_for(user)))
+                try:
+                    resp = await inner(request, context)
+                except grpc.aio.AbortError:
+                    end_audit(actx, _GRPC_AUDIT_CODE.get(
+                        context.code(), 500))
+                    raise
+                except Exception:
+                    # Non-StoreError bug: gRPC will return UNKNOWN; the
+                    # audit trail still gets its ResponseComplete (the
+                    # HTTP wire records these as 500 the same way).
+                    end_audit(actx, 500)
+                    raise
+                finally:
+                    _CALLER.reset(token)
+                end_audit(actx, 200)
+                return resp
+
+            return grpc.unary_unary_rpc_method_handler(
+                uu, request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+
+        if handler.unary_stream is not None:
+            inner_stream = handler.unary_stream
+
+            async def us(request, context):
+                actx = begin_audit(request)
+                if fail is not None:
+                    end_audit(actx, _GRPC_AUDIT_CODE.get(fail[0], 500))
+                    await context.abort(*fail)
+                denied = check_authz(request)
+                if denied is not None:
+                    end_audit(actx, 403)
+                    await context.abort(
+                        grpc.StatusCode.PERMISSION_DENIED, denied)
+                end_audit(actx, 200)  # long-running: accepted = complete
+                async for item in inner_stream(request, context):
+                    yield item
+
+            return grpc.unary_stream_rpc_method_handler(
+                us, request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+
+        return handler
+
+
+_GRPC_AUDIT_CODE = {
+    grpc.StatusCode.NOT_FOUND: 404,
+    grpc.StatusCode.ALREADY_EXISTS: 409,
+    grpc.StatusCode.ABORTED: 409,
+    grpc.StatusCode.INVALID_ARGUMENT: 422,
+    grpc.StatusCode.OUT_OF_RANGE: 410,
+    grpc.StatusCode.PERMISSION_DENIED: 403,
+    grpc.StatusCode.UNAUTHENTICATED: 401,
+}
+
+
 def _handlers(svc: StoreService) -> grpc.GenericRpcHandler:
     def uu(fn, req_cls, resp_cls=ktpu_pb2.Unknown):
         return grpc.unary_unary_rpc_method_handler(
@@ -173,23 +379,48 @@ def _handlers(svc: StoreService) -> grpc.GenericRpcHandler:
 
 
 class GRPCAPIServer:
-    """Serve one MVCCStore over gRPC (the §5.8 wire option)."""
+    """Serve one MVCCStore over gRPC (the §5.8 wire option).
+
+    With any of `bearer_tokens` / `authorizer` / `audit` configured, the
+    AuthInterceptor chain (authn → audit → impersonation → authz) runs in
+    front of the service — the same policy objects the HTTP and KTPU
+    wires share."""
 
     def __init__(self, store: MVCCStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, *,
+                 bearer_tokens: Mapping[str, str] | None = None,
+                 user_groups: Mapping[str, list] | None = None,
+                 authorizer=None, audit=None, admission=None):
         self.store = store
         self.host = host
         self.port = port
+        #: WebhookAdmission (webhooks + expression policies) or None.
+        self.admission = admission
+        #: None = authn disabled (anonymous); {} would reject every token.
+        self.bearer_tokens = dict(bearer_tokens) \
+            if bearer_tokens is not None else None
+        self.user_groups = {u: list(g) for u, g in
+                            (user_groups or {}).items()}
+        self.authorizer = authorizer
+        self.audit = audit
         self._server: grpc.aio.Server | None = None
+
+    def groups_for(self, user: str) -> list:
+        groups = list(self.user_groups.get(user, ()))
+        groups.append("system:unauthenticated"
+                      if user == "system:anonymous"
+                      else "system:authenticated")
+        return groups
 
     @property
     def target(self) -> str:
         return f"{self.host}:{self.port}"
 
     async def start(self) -> None:
-        self._server = grpc.aio.server()
+        self._server = grpc.aio.server(
+            interceptors=(AuthInterceptor(self),))
         self._server.add_generic_rpc_handlers(
-            (_handlers(StoreService(self.store)),))
+            (_handlers(StoreService(self.store, self.admission)),))
         self.port = self._server.add_insecure_port(
             f"{self.host}:{self.port}")
         await self._server.start()
@@ -224,15 +455,25 @@ def _map_rpc_error(e: grpc.aio.AioRpcError) -> StoreError:
 class GRPCRemoteStore:
     """MVCCStore-shaped client over the gRPC wire."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, *, token: str | None = None,
+                 impersonate: str | None = None):
         self.target = target
         self._channel = grpc.aio.insecure_channel(target)
+        md = []
+        if token:
+            md.append(("authorization", f"Bearer {token}"))
+        if impersonate:
+            # The interceptor-chain impersonation field (client-go
+            # ImpersonationConfig analog on this wire).
+            md.append(("impersonate-user", impersonate))
+        self._metadata = tuple(md) or None
 
     def _uu(self, method: str, req, resp_cls=ktpu_pb2.Unknown):
         return self._channel.unary_unary(
             f"/{_SERVICE}/{method}",
             request_serializer=type(req).SerializeToString,
-            response_deserializer=resp_cls.FromString)(req)
+            response_deserializer=resp_cls.FromString)(
+                req, metadata=self._metadata)
 
     async def close(self) -> None:
         await self._channel.close()
@@ -324,7 +565,7 @@ class GRPCRemoteStore:
             resource=resource,
             resource_version=str(resource_version)
             if resource_version is not None else "",
-            label_selector=sel or ""))
+            label_selector=sel or ""), metadata=self._metadata)
 
         async def gen():
             try:

@@ -11,8 +11,10 @@ from __future__ import annotations
 
 from typing import Iterable, Mapping
 
-#: verbs the request-info middleware produces.
-VERBS = ("get", "list", "watch", "create", "update", "patch", "delete")
+#: verbs the request-info middleware produces, plus the impersonation
+#: filter's `impersonate` check (resource "users").
+VERBS = ("get", "list", "watch", "create", "update", "patch", "delete",
+         "impersonate")
 
 
 def make_cluster_role(name: str, rules: list[Mapping]) -> dict:

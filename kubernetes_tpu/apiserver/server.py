@@ -212,6 +212,7 @@ class APIServer:
                  admission=None,
                  metrics_registry=None,
                  audit_log: bool = False,
+                 audit=None,
                  tracer=None):
         self.store = store
         self.host = host
@@ -238,10 +239,18 @@ class APIServer:
         #: mutating/validating webhook out-calls.
         self.admission = admission
         self.metrics_registry = metrics_registry
+        #: policy/audit.AuditPipeline or None = no stage-event audit
+        #: (the legacy `audit_log` flat line remains available).
+        self.audit = audit
         if metrics_registry is not None:
             # Watch-dispatch counters live on the store (it owns dispatch);
             # surface them through this server's /metrics exposition.
             store.watch_metrics.register_into(metrics_registry)
+            if audit is not None:
+                audit.register_into(metrics_registry)
+            engine = getattr(admission, "policy_engine", None)
+            if engine is not None:
+                engine.register_into(metrics_registry)
         self.audit_log = audit_log
         #: OTel-style request spans (SURVEY §5.1); defaults to the
         #: process tracer, which is disabled unless someone enables it.
@@ -256,13 +265,19 @@ class APIServer:
     # -- handler chain (DefaultBuildHandlerChain order) --------------------
 
     def _build_app(self) -> web.Application:
+        # The reference's DefaultBuildHandlerChain order (§3.2): authn →
+        # audit → impersonation → APF → authz. Audit sits OUTSIDE
+        # impersonation so RequestReceived carries the authenticated
+        # principal and ResponseComplete records the impersonated one;
+        # authz runs innermost, as the impersonated user.
         app = web.Application(middlewares=[
             self._mw_recovery,        # WithPanicRecovery
             self._mw_request_info,    # WithRequestInfo
             self._mw_trace,           # WithTracing (OTel spans, §5.1)
             self._mw_authn,           # WithAuthentication
+            self._mw_audit,           # WithAudit (stage events, §5.5)
+            self._mw_impersonation,   # WithImpersonation
             self._mw_priority,        # WithPriorityAndFairness
-            self._mw_audit,           # WithAudit (records authz denials)
             self._mw_authz,           # WithAuthorization (RBAC, innermost)
         ])
         app.router.add_get("/healthz", self._healthz)
@@ -383,6 +398,53 @@ class APIServer:
                       else "system:authenticated")
         return groups
 
+    def _request_groups(self, request: web.Request) -> list[str]:
+        """Effective groups for the request's CURRENT identity —
+        Impersonate-Group headers win over configured group membership
+        once impersonation swapped users (the reference's
+        user.Info.Groups after the impersonation filter)."""
+        override = request.get("groups")
+        if override is not None:
+            return override
+        return self._groups_for(request.get("user", "system:anonymous"))
+
+    @web.middleware
+    async def _mw_impersonation(self, request: web.Request, handler):
+        """WithImpersonation: Impersonate-User swaps the request identity
+        when RBAC grants the AUTHENTICATED user the `impersonate` verb on
+        `users` (plugin order: after audit — so audit sees both sides —
+        before APF/authz, which run as the impersonated user)."""
+        target = request.headers.get("Impersonate-User")
+        if not target:
+            return await handler(request)
+        user = request.get("user", "system:anonymous")
+        if self.authorizer is not None and not self.authorizer.allowed(
+                user, "impersonate", "users",
+                groups=self._groups_for(user)):
+            return web.json_response(_status_body(
+                403, "Forbidden",
+                f'user "{user}" cannot impersonate user "{target}"'),
+                status=403)
+        imp_groups = request.headers.getall("Impersonate-Group", [])
+        if imp_groups and self.authorizer is not None and \
+                not self.authorizer.allowed(
+                    user, "impersonate", "groups",
+                    groups=self._groups_for(user)):
+            # Group impersonation is a SEPARATE grant (the reference
+            # checks each impersonated attribute on its own resource):
+            # impersonate-on-users must not let a caller self-assign
+            # arbitrary group memberships.
+            return web.json_response(_status_body(
+                403, "Forbidden",
+                f'user "{user}" cannot impersonate groups'), status=403)
+        request["original_user"] = user
+        request["impersonated_user"] = target
+        request["user"] = target
+        if imp_groups:
+            request["groups"] = [*imp_groups, "system:authenticated"]
+        self.tracer.annotate(user=target)
+        return await handler(request)
+
     @web.middleware
     async def _mw_authz(self, request: web.Request, handler):
         # Non-resource paths (health, metrics, discovery, openapi) are
@@ -394,7 +456,7 @@ class APIServer:
         verb = request.get("verb", "")
         resource = request.get("resource", "")
         if not self.authorizer.allowed(user, verb, resource,
-                                       groups=self._groups_for(user)):
+                                       groups=self._request_groups(request)):
             return web.json_response(_status_body(
                 403, "Forbidden",
                 f'user "{user}" cannot {verb} resource "{resource}"'),
@@ -424,12 +486,85 @@ class APIServer:
 
     @web.middleware
     async def _mw_audit(self, request: web.Request, handler):
-        resp = await handler(request)
+        """WithAudit: policy-selected level, RequestReceived emitted
+        before the inner chain (pre-impersonation identity — audit sits
+        outside the impersonation filter, like the reference), and
+        ResponseComplete after, carrying the final status plus
+        `impersonatedUser` when the identity was swapped mid-chain."""
+        pipeline = self.audit
+        resource = request.get("resource", "")
+        if pipeline is None or not resource:
+            resp = await handler(request)
+            if self.audit_log:
+                logger.info(
+                    "audit user=%s verb=%s resource=%s ns=%s name=%s "
+                    "code=%s",
+                    request.get("user"), request.get("verb"),
+                    request.get("resource"), request.get("namespace"),
+                    request.match_info.get("name"), resp.status)
+            return resp
+        from kubernetes_tpu.policy.audit import (  # noqa: PLC0415 — lazy:
+            LEVEL_REQUEST,                         # policy/ is optional
+            LEVEL_REQUEST_RESPONSE,                # for audit-less servers
+            level_at_least,
+        )
+        user = request.get("user", "system:anonymous")
+        groups = self._groups_for(user)
+        verb = request.get("verb", "")
+        namespace = request.get("namespace")
+        rule = pipeline.policy.rule_for(
+            user=user, groups=groups, verb=verb, resource=resource,
+            namespace=namespace)
+        level = rule.get("level", "None") if rule else "None"
+        req_obj = None
+        if level_at_least(level, LEVEL_REQUEST) and request.can_read_body:
+            # aiohttp caches the raw body, so the handler's own
+            # request.json() still works after this read.
+            try:
+                req_obj = json.loads(await request.read())
+            except (ValueError, json.JSONDecodeError):
+                req_obj = None
+        name = request.match_info.get("name") or \
+            ((req_obj or {}).get("metadata") or {}).get("name")
+        ctx = pipeline.begin(
+            user=user, groups=groups, verb=verb, resource=resource,
+            namespace=namespace, name=name, request_object=req_obj,
+            rule=rule)
+        try:
+            resp = await handler(request)
+        except Exception as e:
+            pipeline.response_complete(
+                ctx, code=_code_reason(e)[0],
+                impersonated_user=request.get("impersonated_user"))
+            raise
+        resp_obj = None
+        # Creates carry no name in the URL: the reference fills
+        # objectRef.Name from the RESPONSE object at ResponseComplete.
+        # Only creates — a LIST also has no URL name, but parsing a
+        # multi-MB list body to hunt for a name it cannot contain would
+        # tax the serving path for nothing.
+        need_name = ctx is not None and verb == "create" and \
+            not ctx["objectRef"]["name"]
+        if (need_name
+                or level_at_least(level, LEVEL_REQUEST_RESPONSE)) and \
+                getattr(resp, "body", None) and \
+                "json" in (resp.content_type or ""):
+            try:
+                parsed = json.loads(resp.body)
+            except (ValueError, json.JSONDecodeError, TypeError):
+                parsed = None
+            if need_name and isinstance(parsed, dict):
+                ctx["objectRef"]["name"] = (
+                    parsed.get("metadata") or {}).get("name", "")
+            if level_at_least(level, LEVEL_REQUEST_RESPONSE):
+                resp_obj = parsed
+        pipeline.response_complete(
+            ctx, code=resp.status, response_object=resp_obj,
+            impersonated_user=request.get("impersonated_user"))
         if self.audit_log:
             logger.info(
                 "audit user=%s verb=%s resource=%s ns=%s name=%s code=%s",
-                request.get("user"), request.get("verb"),
-                request.get("resource"), request.get("namespace"),
+                user, verb, resource, namespace,
                 request.match_info.get("name"), resp.status)
         return resp
 
@@ -649,7 +784,9 @@ class APIServer:
                 with self.tracer.span("admission.webhooks",
                                       resource=resource, op="create"):
                     obj = await self.admission.admit(
-                        obj, resource, "create")
+                        obj, resource, "create",
+                        user=request.get("user"),
+                        groups=self._request_groups(request))
             with self.tracer.span("store.create", resource=resource):
                 created = await self.store.create(resource, obj)
             return _object_response(request, created, status=201)
@@ -672,7 +809,9 @@ class APIServer:
             if request["namespace"]:
                 meta.setdefault("namespace", request["namespace"])
             if self.admission is not None:
-                obj = await self.admission.admit(obj, resource, "update")
+                obj = await self.admission.admit(
+                    obj, resource, "update", user=request.get("user"),
+                    groups=self._request_groups(request))
             return _object_response(
                 request, await self.store.update(resource, obj))
         if request.method == "PATCH" and "apply-patch" in \
@@ -691,13 +830,52 @@ class APIServer:
                     400, "BadRequest", "fieldManager is required"),
                     status=400)
             if self.admission is not None:
-                obj = await self.admission.admit(obj, resource, "update")
+                obj = await self.admission.admit(
+                    obj, resource, "update", user=request.get("user"),
+                    groups=self._request_groups(request))
             out = await self.store.apply(
                 resource, obj, field_manager=manager,
                 force=request.query.get("force") in ("true", "1"))
             # 200 for both create and update (the reference 201s fresh
             # creates; callers here key off the object, not the code).
             return _object_response(request, out)
+        if request.method == "PATCH":
+            # Strategic-merge / merge patch (kubectl patch): read-modify-
+            # write over the live object. The merged result flows through
+            # the FULL admission chain — webhooks + expression policies —
+            # exactly like a PUT (the reference's patchResource path).
+            ct = request.headers.get("Content-Type", "")
+            patch = await request.json()
+            from kubernetes_tpu.store.apply import strategic_merge_patch
+            # Patch carries no client RV precondition, so a concurrent
+            # writer must not surface as a spurious 409: re-read and
+            # re-merge on Conflict (the reference's patchResource retry).
+            for attempt in range(8):
+                current = await self.store.get(resource, key)
+                if "json-patch" in ct:
+                    from kubernetes_tpu.apiserver.admission import (
+                        apply_json_patch,
+                    )
+                    merged = apply_json_patch(current, patch)
+                else:
+                    # application/strategic-merge-patch+json and
+                    # application/merge-patch+json: dict deep-merge; the
+                    # strategic variant also merges named list entries.
+                    merged = strategic_merge_patch(
+                        current, patch, strategic="strategic" in ct or
+                        not ct.startswith("application/merge-patch"))
+                if self.admission is not None:
+                    merged = await self.admission.admit(
+                        merged, resource, "update",
+                        user=request.get("user"),
+                        groups=self._request_groups(request))
+                try:
+                    return _object_response(
+                        request, await self.store.update(resource, merged))
+                except Conflict:
+                    if attempt == 7:
+                        raise
+                    continue
         if request.method == "DELETE":
             uid = None
             if request.can_read_body:
@@ -710,7 +888,10 @@ class APIServer:
                 # Webhooks see the object being deleted (patches have no
                 # meaning on delete; deny aborts it).
                 current = await self.store.get(resource, key)
-                await self.admission.admit(current, resource, "delete")
+                await self.admission.admit(
+                    current, resource, "delete",
+                    user=request.get("user"),
+                    groups=self._request_groups(request))
             return web.json_response(
                 await self.store.delete(resource, key, uid=uid))
         raise web.HTTPMethodNotAllowed(
@@ -825,6 +1006,8 @@ class APIServer:
         if self._proxy_session is not None:
             await self._proxy_session.close()
             self._proxy_session = None
+        if self.audit is not None:
+            await self.audit.close()
         if self.admission is not None:
             await self.admission.close()
         if self._runner is not None:

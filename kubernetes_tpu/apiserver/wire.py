@@ -89,6 +89,12 @@ _VERB_OF = {"create": "create", "get": "get", "update": "update",
             "delete": "delete", "sub": "update", "list": "list",
             "watch": "watch", "kinds": "get", "apply": "patch"}
 
+#: StoreError reason → HTTP-equivalent code for audit responseStatus.
+_CODE_OF_REASON = {"NotFound": 404, "AlreadyExists": 409,
+                   "Conflict": 409, "Invalid": 422, "Expired": 410,
+                   "Forbidden": 403, "TooManyRequests": 429,
+                   "BadRequest": 400, "Unauthorized": 401}
+
 _dumps = json.dumps
 _packb = msgpack.packb
 _unpackb = msgpack.unpackb
@@ -167,10 +173,16 @@ class _Conn(asyncio.Protocol):
         self.transport: asyncio.Transport | None = None
         self.buf = bytearray()
         self.user = "system:anonymous"
+        #: the AUTHENTICATED principal — differs from `user` when the
+        #: hello frame's impersonate field swapped identities; audit
+        #: events record this as `user` and `user` as impersonatedUser.
+        self.auth_user = "system:anonymous"
         self.flow = "wire"
         #: codec the peer speaks (learned per received frame; replies and
         #: watch pushes mirror it).
         self._mp = False
+        #: one hello per connection (see _hello).
+        self._hello_done = False
         #: watch id -> pump task
         self.watches: dict[str, asyncio.Task] = {}
         self._out: list[bytes] = []
@@ -255,8 +267,44 @@ class _Conn(asyncio.Protocol):
 
     # -- handler chain (server.py middleware order) ------------------------
 
+    # -- audit stage events ------------------------------------------------
+
+    def _audit_begin(self, op: str, verb: str, resource: str,
+                     frame: list):
+        """RequestReceived for one frame op — BEFORE APF/authz, the
+        reference chain position (audit outside everything but authn)."""
+        pipeline = self.server.audit
+        if pipeline is None or not resource:
+            return None
+        name = namespace = None
+        request_object = None
+        arg = frame[3] if len(frame) > 3 else None
+        if op in ("create", "update", "apply") and isinstance(arg, dict):
+            meta = arg.get("metadata") or {}
+            name = meta.get("name")
+            namespace = meta.get("namespace")
+            request_object = arg
+        elif isinstance(arg, str):  # get/delete/sub carry a key
+            namespace, _, name = arg.rpartition("/")
+            namespace = namespace or None
+        return pipeline.begin(
+            user=self.auth_user,
+            groups=self.server.groups_for(self.auth_user),
+            verb=verb, resource=resource, namespace=namespace,
+            name=name, request_object=request_object)
+
+    def _audit_end(self, actx, code: int, result=None) -> None:
+        if actx is None:
+            return
+        self.server.audit.response_complete(
+            actx, code=code,
+            response_object=result if isinstance(result, dict) else None,
+            impersonated_user=self.user
+            if self.user != self.auth_user else None)
+
     async def _handle(self, frame: list) -> None:
         rid = ""
+        actx = None
         try:
             rid, op = frame[0], frame[1]
             if op == "hello":
@@ -266,24 +314,29 @@ class _Conn(asyncio.Protocol):
                 if t is not None:
                     t.cancel()
                 return
-            # authz (RBAC): same rule set as the HTTP server.
+            if op == "multi":
+                return await self._multi(rid, frame[2])
             srv = self.server
             verb = _VERB_OF.get(op, op)
             resource = frame[2] if len(frame) > 2 and \
                 isinstance(frame[2], str) else ""
-            if srv.authorizer is not None and resource and \
-                    not srv.authorizer.allowed(
-                        self.user, verb, resource,
-                        groups=srv.groups_for(self.user)):
-                return self._err(
-                    rid, "Forbidden",
-                    f'user "{self.user}" cannot {verb} resource '
-                    f'"{resource}"')
+            # audit: RequestReceived before APF/authz (reference chain
+            # position; authn + impersonation were hello-time).
+            actx = self._audit_begin(op, verb, resource, frame)
             if op == "watch":
-                return await self._start_watch(rid, frame[2],
-                                               frame[3] or {})
-            if op == "multi":
-                return await self._multi(rid, frame[2])
+                # No APF seat (cacher semantics) but authz still applies.
+                if srv.authorizer is not None and resource and \
+                        not srv.authorizer.allowed(
+                            self.user, verb, resource,
+                            groups=srv.groups_for(self.user)):
+                    self._audit_end(actx, 403)
+                    return self._err(
+                        rid, "Forbidden",
+                        f'user "{self.user}" cannot {verb} resource '
+                        f'"{resource}"')
+                await self._start_watch(rid, frame[2], frame[3] or {})
+                self._audit_end(actx, 200)
+                return
             # APF: watches hold no seat (cacher semantics); everything
             # else acquires one from the shared priority levels.
             level = srv.classify(resource)
@@ -291,23 +344,40 @@ class _Conn(asyncio.Protocol):
                 try:
                     await level.acquire(self.flow)
                 except Exception:
+                    self._audit_end(actx, 429)
                     return self._err(rid, "TooManyRequests",
                                      f"priority level {level.name!r} "
                                      "queue full")
             try:
+                # authz (RBAC) innermost, as the (possibly impersonated)
+                # request identity — same rule set as the HTTP server.
+                if srv.authorizer is not None and resource and \
+                        not srv.authorizer.allowed(
+                            self.user, verb, resource,
+                            groups=srv.groups_for(self.user)):
+                    self._audit_end(actx, 403)
+                    return self._err(
+                        rid, "Forbidden",
+                        f'user "{self.user}" cannot {verb} resource '
+                        f'"{resource}"')
                 result = await self._dispatch(op, frame)
             finally:
                 if level is not None:
                     level.release()
+            self._audit_end(actx, 200 if op != "create" else 201, result)
             self._ok(rid, result)
         except StoreError as e:
-            self._err(rid, _reason_for(e), str(e))
+            reason = _reason_for(e)
+            self._audit_end(actx, _CODE_OF_REASON.get(reason, 500))
+            self._err(rid, reason, str(e))
         except asyncio.CancelledError:
             raise
         except (ValueError, KeyError, IndexError, TypeError) as e:
+            self._audit_end(actx, 400)
             self._err(rid, "BadRequest", f"malformed frame: {e!r}")
         except Exception:
             logger.exception("wire: panic handling frame")
+            self._audit_end(actx, 500)
             self._err(rid, "InternalError", "internal error")
 
     async def _multi(self, rid: str, ops: list) -> None:
@@ -346,25 +416,37 @@ class _Conn(asyncio.Protocol):
                 for idx in idxs:
                     sub = ops[idx]
                     op = sub[0]
+                    actx = None
                     try:
                         resource = sub[1] if len(sub) > 1 and \
                             isinstance(sub[1], str) else ""
                         verb = _VERB_OF.get(op, op)
+                        # Per-op audit, same stages as the single-op path
+                        # (one coalesced frame is still N requests).
+                        actx = self._audit_begin(op, verb, resource,
+                                                 ["", *sub])
                         if srv.authorizer is not None and resource and \
                                 not srv.authorizer.allowed(
                                     self.user, verb, resource,
                                     groups=srv.groups_for(self.user)):
+                            self._audit_end(actx, 403)
                             results[idx] = [
                                 "err", "Forbidden",
                                 f'user "{self.user}" cannot {verb} '
                                 f'resource "{resource}"']
                             continue
-                        results[idx] = [
-                            "ok", await self._dispatch(op, ["", *sub])]
+                        result = await self._dispatch(op, ["", *sub])
+                        self._audit_end(
+                            actx, 200 if op != "create" else 201, result)
+                        results[idx] = ["ok", result]
                     except StoreError as e:
-                        results[idx] = ["err", _reason_for(e), str(e)]
+                        reason = _reason_for(e)
+                        self._audit_end(
+                            actx, _CODE_OF_REASON.get(reason, 500))
+                        results[idx] = ["err", reason, str(e)]
                     except (ValueError, KeyError, IndexError,
                             TypeError) as e:
+                        self._audit_end(actx, 400)
                         results[idx] = ["err", "BadRequest",
                                         f"malformed op: {e!r}"]
             finally:
@@ -374,6 +456,17 @@ class _Conn(asyncio.Protocol):
 
     def _hello(self, rid: str, args: Mapping) -> None:
         srv = self.server
+        if self._hello_done:
+            # One handshake per connection: a second hello could reset
+            # auth_user to the impersonated identity (erasing the real
+            # principal from the audit trail) or re-authenticate the
+            # session mid-stream. Refuse and drop the connection.
+            self._err(rid, "BadRequest", "session already authenticated")
+            self._flush()
+            if self.transport is not None:
+                self.transport.close()
+            return
+        self._hello_done = True
         token = args.get("token")
         self.flow = args.get("ua") or "wire"
         if token:
@@ -392,15 +485,39 @@ class _Conn(asyncio.Protocol):
                     self.transport.close()
                 return
             self.user = user or "system:anonymous"
+        self.auth_user = self.user
+        target = args.get("impersonate")
+        if target:
+            # WithImpersonation, frame-field form: the session adopts the
+            # target identity for every subsequent frame (client-go's
+            # transport-level ImpersonationConfig), gated by the RBAC
+            # `impersonate` verb for the AUTHENTICATED user. A denial
+            # refuses the session, like a bad token — silently continuing
+            # as the original user would mask a policy violation.
+            if srv.authorizer is not None and not srv.authorizer.allowed(
+                    self.auth_user, "impersonate", "users",
+                    groups=srv.groups_for(self.auth_user)):
+                self._err(rid, "Forbidden",
+                          f'user "{self.auth_user}" cannot impersonate '
+                          f'user "{target}"')
+                self._flush()
+                if self.transport is not None:
+                    self.transport.close()
+                return
+            self.user = target
         self._ok(rid, {"user": self.user})
 
     async def _dispatch(self, op: str, frame: list):
         store = self.server.store
         admission = self.server.admission
+        user = self.user
+        groups = self.server.groups_for(user) \
+            if admission is not None else None
         if op == "create":
             resource, obj = frame[2], frame[3]
             if admission is not None:
-                obj = await admission.admit(obj, resource, "create")
+                obj = await admission.admit(obj, resource, "create",
+                                            user=user, groups=groups)
             # The decoded object is exclusively ours (just parsed off the
             # socket): hand ownership to the store and skip its entry
             # deep-copy; the response encodes the stored object directly.
@@ -411,14 +528,16 @@ class _Conn(asyncio.Protocol):
         if op == "update":
             resource, obj = frame[2], frame[3]
             if admission is not None:
-                obj = await admission.admit(obj, resource, "update")
+                obj = await admission.admit(obj, resource, "update",
+                                            user=user, groups=groups)
             return await store.update(resource, obj)
         if op == "delete":
             resource, key = frame[2], frame[3]
             uid = frame[4] if len(frame) > 4 else None
             if admission is not None:
                 current = await store.get(resource, key)
-                await admission.admit(current, resource, "delete")
+                await admission.admit(current, resource, "delete",
+                                      user=user, groups=groups)
             return await store.delete(resource, key, uid=uid)
         if op == "sub":
             return await store.subresource(
@@ -426,7 +545,8 @@ class _Conn(asyncio.Protocol):
         if op == "apply":
             resource, obj = frame[2], frame[3]
             if admission is not None:
-                obj = await admission.admit(obj, resource, "update")
+                obj = await admission.admit(obj, resource, "update",
+                                            user=user, groups=groups)
             return await store.apply(
                 resource, obj, field_manager=frame[4],
                 force=bool(frame[5] if len(frame) > 5 else False))
@@ -523,15 +643,23 @@ class _Conn(asyncio.Protocol):
 
 class WireServer:
     """Serve an MVCCStore over the KTPU wire. Policy objects (priority
-    levels, tokens, RBAC authorizer, admission) are shared with an
-    APIServer when one exists, so both wires enforce identical rules."""
+    levels, tokens, RBAC authorizer, admission, audit pipeline) are
+    shared with an APIServer when one exists, so both wires enforce
+    identical rules."""
+
+    #: per-frame stage order, mirroring server.py's middleware list /
+    #: the reference's DefaultBuildHandlerChain (§3.2). authn and
+    #: impersonation are connection-scoped (the hello frame); the rest
+    #: run per frame in this order — the chain-order tests pin it.
+    HANDLER_CHAIN = ("authn", "audit", "impersonation", "apf", "authz",
+                     "admission")
 
     def __init__(self, store: MVCCStore, *, host: str = "127.0.0.1",
                  port: int = 0, priority_levels: Mapping | None = None,
                  bearer_tokens: Mapping[str, str] | None = None,
                  token_authenticator=None,
                  user_groups: Mapping[str, list[str]] | None = None,
-                 authorizer=None, admission=None):
+                 authorizer=None, admission=None, audit=None):
         self.store = store
         self.host = host
         self.port = port
@@ -542,6 +670,9 @@ class WireServer:
                             (user_groups or {}).items()}
         self.authorizer = authorizer
         self.admission = admission
+        #: policy/audit.AuditPipeline or None (shared with the HTTP
+        #: server via for_apiserver — ONE sink for both wires).
+        self.audit = audit
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_Conn] = set()
         self._path = ""
@@ -556,7 +687,8 @@ class WireServer:
                    bearer_tokens=api.bearer_tokens,
                    token_authenticator=api.token_authenticator,
                    user_groups=api.user_groups,
-                   authorizer=api.authorizer, admission=api.admission)
+                   authorizer=api.authorizer, admission=api.admission,
+                   audit=api.audit)
 
     def classify(self, resource: str):
         if not self.priority_levels:
@@ -689,7 +821,7 @@ class WireStore:
 
     def __init__(self, target: str, *, token: str | None = None,
                  user_agent: str = "kubernetes-tpu-wire",
-                 enc: str = "msgpack"):
+                 enc: str = "msgpack", impersonate: str | None = None):
         if target.startswith("unix:"):
             self.path: str | None = target[len("unix:"):]
             self.host, self.port = "", 0
@@ -699,6 +831,10 @@ class WireStore:
             self.host, self.port = host or "127.0.0.1", int(port)
         self.token = token
         self.user_agent = user_agent
+        #: session-wide impersonation target (client-go's transport-level
+        #: ImpersonationConfig analog) — rides the hello frame; the server
+        #: RBAC-gates it on the authenticated user's `impersonate` verb.
+        self.impersonate = impersonate
         #: frame codec: "msgpack" (default — the binary fast path) or
         #: "json"; the server mirrors whichever the client speaks.
         self._encode = (_packb if enc == "msgpack" else
@@ -742,9 +878,10 @@ class WireStore:
                 _t, proto = await loop.create_connection(
                     lambda: _ClientProto(self), self.host, self.port)
             self._proto = proto
-            hello = await self._call(
-                "hello", {"token": self.token, "ua": self.user_agent},
-                _pre_auth=True)
+            hello_args = {"token": self.token, "ua": self.user_agent}
+            if self.impersonate:
+                hello_args["impersonate"] = self.impersonate
+            hello = await self._call("hello", hello_args, _pre_auth=True)
             logger.debug("wire connected as %s", hello.get("user"))
             self._connecting.set_result(None)
         except BaseException as e:

@@ -2,12 +2,14 @@
 
 Parity target: staging/src/k8s.io/kubectl `pkg/cmd/` — the operational
 verbs an operator needs against the API server: get, describe, apply,
-create, delete, scale, cordon/uncordon, drain, top. Talks HTTP to an
-APIServer (`--server`), or to an in-process store when a caller passes
-one (tests, embedded tools).
+create, patch, delete, scale, cordon/uncordon, drain, top, rollout.
+Talks HTTP to an APIServer (`--server`), or to an in-process store when
+a caller passes one (tests, embedded tools).
 
     python -m kubernetes_tpu.cli get pods -n default
     python -m kubernetes_tpu.cli apply -f manifest.yaml
+    python -m kubernetes_tpu.cli create -f manifest.yaml
+    python -m kubernetes_tpu.cli patch pods web -p '{"spec": {...}}'
     python -m kubernetes_tpu.cli drain node-3
 """
 
@@ -236,6 +238,68 @@ async def cmd_apply(store, args, out) -> int:
         await store.update(resource, merged)
         print(f"{resource}/{meta.get('name')} configured", file=out)
     return rc
+
+
+async def cmd_create(store, args, out) -> int:
+    """kubectl create -f: create-only (unlike apply, an existing object
+    is an error — pkg/cmd/create semantics)."""
+    rc = 0
+    for obj in _load_manifests(args.filename):
+        resource = _kind_map(store).get(obj.get("kind", ""))
+        if resource is None:
+            print(f"Error: unknown kind {obj.get('kind')!r}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        meta = obj.setdefault("metadata", {})
+        if not _cluster_scoped(store, resource):
+            meta.setdefault("namespace", args.namespace)
+        try:
+            await store.create(resource, obj)
+            print(f"{resource}/{meta.get('name')} created", file=out)
+        except StoreError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+async def cmd_patch(store, args, out) -> int:
+    """kubectl patch: strategic-merge (default) / merge / json patch.
+    Against a RemoteStore the server merges and the result runs the
+    FULL admission chain (webhooks + expression policies); in-process
+    stores fall back to a local merge + guaranteed_update."""
+    resource = _resource(args.resource)
+    key = _key(store, resource, args.name, args.namespace)
+    try:
+        patch = json.loads(args.patch)
+    except json.JSONDecodeError as e:
+        print(f"Error: bad patch JSON: {e}", file=sys.stderr)
+        return 1
+    try:
+        remote_patch = getattr(store, "patch", None)
+        if remote_patch is not None:
+            await remote_patch(resource, key, patch,
+                               patch_type=args.type)
+        elif args.type == "json":
+            from kubernetes_tpu.apiserver.admission import (
+                apply_json_patch,
+            )
+            await store.guaranteed_update(
+                resource, key,
+                lambda cur: apply_json_patch(cur, patch),
+                return_copy=False)
+        else:
+            from kubernetes_tpu.store.apply import strategic_merge_patch
+            await store.guaranteed_update(
+                resource, key,
+                lambda cur: strategic_merge_patch(
+                    cur, patch, strategic=args.type == "strategic"),
+                return_copy=False)
+    except StoreError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"{resource}/{args.name} patched", file=out)
+    return 0
 
 
 async def cmd_delete(store, args, out) -> int:
@@ -498,6 +562,19 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--force-conflicts", action="store_true",
                    help="take ownership of conflicting fields")
     a.set_defaults(fn=cmd_apply)
+
+    cr = sub.add_parser("create")
+    cr.add_argument("-f", "--filename", required=True)
+    cr.set_defaults(fn=cmd_create)
+
+    pa = sub.add_parser("patch")
+    pa.add_argument("resource")
+    pa.add_argument("name")
+    pa.add_argument("-p", "--patch", required=True,
+                    help="patch document (JSON)")
+    pa.add_argument("--type", choices=["strategic", "merge", "json"],
+                    default="strategic")
+    pa.set_defaults(fn=cmd_patch)
 
     rm = sub.add_parser("delete")
     rm.add_argument("resource", nargs="?")

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from typing import Mapping
 
 from kubernetes_tpu.api.meta import name_of, namespace_of, new_object, now_iso
@@ -19,6 +20,34 @@ from kubernetes_tpu.store.mvcc import MVCCStore, StoreError
 
 logger = logging.getLogger(__name__)
 _seq = itertools.count(1)
+
+
+class _SpamFilter:
+    """Per-(source, reason) token bucket (events_cache.go
+    EventSourceObjectSpamFilter, keyed coarser: the reference keys by
+    source+object; at scheduler_perf scale per-object buckets never
+    fill, so the budget here is per reason FAMILY — a FailedScheduling
+    retry storm drains its own bucket without touching "Scheduled"'s)."""
+
+    def __init__(self, burst: int = 512, qps: float = 256.0):
+        self.burst = burst
+        self.qps = qps
+        #: (component, reason) -> [tokens, last_refill_monotonic]
+        self._buckets: dict[tuple[str, str], list[float]] = {}
+
+    def allow(self, source: str, reason: str) -> bool:
+        now = time.monotonic()
+        b = self._buckets.get((source, reason))
+        if b is None:
+            self._buckets[(source, reason)] = [self.burst - 1.0, now]
+            return True
+        tokens = min(self.burst, b[0] + (now - b[1]) * self.qps)
+        b[1] = now
+        if tokens < 1.0:
+            b[0] = tokens
+            return False
+        b[0] = tokens - 1.0
+        return True
 
 
 class EventRecorder:
@@ -33,6 +62,20 @@ class EventRecorder:
     #: allowed to backpressure the scheduling path.
     MAX_PENDING = 1000
 
+    #: Reasons that carry per-pod signal a drop would DESTROY (the
+    #: 1000-agent mark-Running shedding fix): "Scheduled" is emitted once
+    #: per bind, so unlike a FailedScheduling retry storm no later event
+    #: repeats the information. Priority events (a) bypass the spam
+    #: filter, (b) ride a deeper bound (MAX_PENDING_PRIORITY), (c) may
+    #: evict a buffered non-priority event when the shared bound is hit,
+    #: and (d) drain first.
+    PRIORITY_REASONS = frozenset({"Scheduled"})
+
+    #: bound for priority-reason events: deep enough to absorb one
+    #: scheduler super-batch of binds (bench batch-size 16384 order),
+    #: still a hard cap — DropIfChannelFull semantics survive.
+    MAX_PENDING_PRIORITY = 16384
+
     #: create() concurrency per drain window: the wire transport coalesces
     #: a whole window into one multiplexed frame, so draining 128-wide
     #: instead of one-awaited-create-per-tick is what keeps the buffer
@@ -42,6 +85,10 @@ class EventRecorder:
     def __init__(self, store: MVCCStore, component: str):
         self.store = store
         self.component = component
+        #: per-(source, reason) token bucket: a repeating reason that
+        #: outruns its refill budget sheds EARLY, before it can occupy
+        #: buffer slots the priority reasons need.
+        self._spam = _SpamFilter()
         self._pending: list[dict] = []
         #: EventCorrelator-lite (record/events_cache.go EventAggregator):
         #: (kind, namespace, name, type, reason) → the pending Event dict,
@@ -58,6 +105,9 @@ class EventRecorder:
         self.emitted = 0
         #: event() calls folded into an already-pending Event's count.
         self.aggregated = 0
+        #: drops attributable to the per-(source, reason) spam filter
+        #: (a subset of `dropped`).
+        self.spam_filtered = 0
 
     def event(self, obj: Mapping, event_type: str, reason: str, message: str) -> None:
         """Fire-and-forget, like the reference's buffered broadcaster."""
@@ -74,14 +124,25 @@ class EventRecorder:
             # recurrence must flush it just like a fresh event would.
             self._kick_drain()
             return
-        if len(self._pending) >= self.MAX_PENDING:
+        priority = reason in self.PRIORITY_REASONS
+        if not priority and not self._spam.allow(self.component, reason):
+            # Reason family over its token budget: shed here, before the
+            # repeat can occupy a slot (EventSourceObjectSpamFilter).
+            self.spam_filtered += 1
             self.dropped += 1
-            if self.dropped % 1000 == 1:
-                logger.warning(
-                    "event buffer full (%d pending); dropped %d events so "
-                    "far (DropIfChannelFull)", len(self._pending),
-                    self.dropped)
             return
+        limit = self.MAX_PENDING_PRIORITY if priority else self.MAX_PENDING
+        if len(self._pending) >= limit:
+            if priority and self._evict_non_priority():
+                self.dropped += 1  # the evicted event
+            else:
+                self.dropped += 1
+                if self.dropped % 1000 == 1:
+                    logger.warning(
+                        "event buffer full (%d pending); dropped %d "
+                        "events so far (DropIfChannelFull)",
+                        len(self._pending), self.dropped)
+                return
         ev = new_object(
             "Event",
             f"{name_of(obj)}.{next(_seq):x}",
@@ -102,6 +163,25 @@ class EventRecorder:
         self._pending.append(ev)
         self._pending_by_key[agg_key] = ev
         self._kick_drain()
+
+    def _evict_non_priority(self) -> bool:
+        """Drop the newest buffered NON-priority event to admit a
+        priority one (the drain-priority bump's admission side): under a
+        bind burst, "Scheduled" displaces retry noise, never vice versa.
+        Scans from the tail — recent entries are the likely noise; runs
+        only on the already-degraded buffer-full path."""
+        for i in range(len(self._pending) - 1, -1, -1):
+            ev = self._pending[i]
+            if ev.get("reason") in self.PRIORITY_REASONS:
+                continue
+            del self._pending[i]
+            io = ev.get("involvedObject") or {}
+            self._pending_by_key.pop(
+                (io.get("kind", ""), io.get("namespace", ""),
+                 io.get("name", ""), ev.get("type", ""),
+                 ev.get("reason", "")), None)
+            return True
+        return False
 
     def _kick_drain(self) -> None:
         if self._draining or not self._pending:
@@ -124,6 +204,12 @@ class EventRecorder:
                 # Batch taken: its entries can no longer aggregate (the
                 # writes are in flight); recurrences start fresh Events.
                 self._pending_by_key.clear()
+                # Drain-priority bump: priority reasons write first, so a
+                # mid-drain process exit or store failure loses noise,
+                # not per-pod "Scheduled" signal. Stable sort keeps
+                # arrival order within each class.
+                batch.sort(key=lambda ev:
+                           ev.get("reason") not in self.PRIORITY_REASONS)
                 for lo in range(0, len(batch), self.DRAIN_WINDOW):
                     # The recorder built these and never touches them
                     # again (_owned); store rejections are per-event debug

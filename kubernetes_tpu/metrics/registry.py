@@ -31,6 +31,13 @@ class Counter:
         with self._lock:
             self._values[key] += amount
 
+    def inc_key(self, key: tuple, amount: float = 1.0) -> None:
+        """Hot-path increment with a caller-cached label tuple (skips
+        per-call label-kwarg resolution; the policy engine incs once per
+        expression per admitted request)."""
+        with self._lock:
+            self._values[key] += amount
+
     def value(self, **labels: str) -> float:
         key = tuple(labels.get(n, "") for n in self.label_names)
         return self._values.get(key, 0.0)

@@ -94,6 +94,13 @@ class WorkloadResult:
         #: to O(events × watchers) shows up here as data.
         self.watch_events_dispatched_total = 0
         self.watch_predicate_checks_total = 0
+        #: Policy-chain accounting over the measured phase
+        #: (policy/vap.py + policy/audit.py): expression evaluations and
+        #: audit stage events. A policy-chain regression (policies
+        #: silently not evaluating, audit silently shedding) is DATA in
+        #: the detail JSON, not stderr noise.
+        self.policy_evaluations_total = 0
+        self.audit_events_total = 0
 
     def as_dict(self) -> dict:
         import math
@@ -122,6 +129,8 @@ class WorkloadResult:
                 self.watch_events_dispatched_total,
             "watch_predicate_checks_total":
                 self.watch_predicate_checks_total,
+            "policy_evaluations_total": self.policy_evaluations_total,
+            "audit_events_total": self.audit_events_total,
         }
 
 
@@ -153,10 +162,22 @@ class PerfRunner:
                  scheduler_kwargs: Mapping | None = None,
                  scheduler_config: Mapping | None = None,
                  through_apiserver: bool = False,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 policy_count: int = 0,
+                 audit_rules: list | None = None):
         self.backend = backend
         self.batch_size = batch_size
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        #: ValidatingAdmissionPolicies (+bindings) installed before the
+        #: run — the policy-chain overhead knob (BASELINE r9: headline
+        #: with a 10-policy set vs disabled). Only meaningful with
+        #: through_apiserver (the policy chain lives on the servers).
+        self.policy_count = policy_count
+        #: audit policy rules for the run's AuditPipeline ([] = level
+        #: None for everything: stage events cost nothing).
+        self.audit_rules = list(audit_rules or [])
+        self._policy_engine = None
+        self._audit = None
         #: Optional inline KubeSchedulerConfiguration (a workload family may
         #: enable non-default plugins, e.g. NodeResourceTopologyMatch).
         self.scheduler_config = scheduler_config
@@ -178,6 +199,28 @@ class PerfRunner:
         server = None
         client = None
         try:
+            api_kw = {}
+            if self.through_apiserver:
+                # The policy chain rides the servers: admission
+                # (webhooks + expression policies) and the audit
+                # pipeline are ALWAYS constructed for boundary-crossing
+                # runs, so the detail JSON's policy/audit counters are
+                # real measurements (zero when no policies/rules exist).
+                from kubernetes_tpu.apiserver.admission import (
+                    WebhookAdmission,
+                )
+                from kubernetes_tpu.policy import (
+                    AuditPipeline,
+                    AuditPolicy,
+                    PolicyEngine,
+                )
+                self._policy_engine = PolicyEngine(backing)
+                self._audit = AuditPipeline(
+                    AuditPolicy(self.audit_rules))
+                api_kw = {"admission": WebhookAdmission(
+                    backing, policy_engine=self._policy_engine),
+                    "audit": self._audit}
+                await self._install_policies(backing)
             if self.through_apiserver == "wire":
                 # The core-component transport: HTTP server up (policy
                 # lives there), store traffic over the multiplexed wire.
@@ -186,7 +229,7 @@ class PerfRunner:
                     WireServer,
                     WireStore,
                 )
-                server = _ServerPair(APIServer(backing), None)
+                server = _ServerPair(APIServer(backing, **api_kw), None)
                 await server.api.start()
                 server.wire = WireServer.for_apiserver(
                     server.api, host="unix:")
@@ -196,7 +239,7 @@ class PerfRunner:
             elif self.through_apiserver:
                 from kubernetes_tpu.apiserver.client import RemoteStore
                 from kubernetes_tpu.apiserver.server import APIServer
-                server = _ServerPair(APIServer(backing), None)
+                server = _ServerPair(APIServer(backing, **api_kw), None)
                 await server.api.start()
                 client = RemoteStore(server.api.url)
                 store = client
@@ -498,8 +541,40 @@ class PerfRunner:
         result.events_dropped_total = sched.recorder.dropped
         return result
 
-    @staticmethod
-    def _begin_measure(metrics: SchedulerMetrics, backing) -> tuple:
+    async def _install_policies(self, backing) -> None:
+        """The overhead knob: N pass-through pod policies + bindings
+        (BASELINE r9 measures the headline with 10 vs 0)."""
+        if not self.policy_count:
+            return
+        from kubernetes_tpu.api.types import (
+            make_validating_admission_policy,
+            make_vap_binding,
+        )
+        for i in range(self.policy_count):
+            name = f"bench-policy-{i}"
+            await backing.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy(name, [
+                    {"expression": "size(object.spec.containers) >= 1"
+                                   " and not has(object.spec.paused)",
+                     "message": "bench policy"}],
+                    match_constraints={"resourceRules": [
+                        {"resources": ["pods"],
+                         "operations": ["CREATE"]}]}))
+            await backing.create("validatingadmissionpolicybindings",
+                                 make_vap_binding(f"{name}-b", name))
+
+    def _policy_totals(self) -> tuple[float, float]:
+        evals = audits = 0.0
+        if self._policy_engine is not None:
+            evals = sum(
+                self._policy_engine.evaluations._values.values())
+        if self._audit is not None:
+            audits = sum(
+                self._audit.sink.events_total._values.values())
+        return evals, audits
+
+    def _begin_measure(self, metrics: SchedulerMetrics, backing) -> tuple:
         deg = metrics.backend_degradations
         wm = backing.watch_metrics
         return (metrics.attempt_duration.snapshot(
@@ -508,13 +583,14 @@ class PerfRunner:
             deg.value(kind="host_fallback"),
             deg.value(kind="spread_poisoned"),
             wm.events_dispatched.value(),
-            wm.predicate_checks.value())
+            wm.predicate_checks.value(),
+            *self._policy_totals())
 
-    @staticmethod
-    def _end_measure(result: WorkloadResult, metrics: SchedulerMetrics,
+    def _end_measure(self, result: WorkloadResult,
+                     metrics: SchedulerMetrics,
                      backing, window: tuple, count: int) -> None:
         (hist_base, t0, fallback_base, poisoned_base,
-         dispatched_base, checks_base) = window
+         dispatched_base, checks_base, evals_base, audits_base) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
         result.measured_seconds = dt
@@ -534,6 +610,9 @@ class PerfRunner:
             wm.events_dispatched.value() - dispatched_base)
         result.watch_predicate_checks_total = int(
             wm.predicate_checks.value() - checks_base)
+        evals, audits = self._policy_totals()
+        result.policy_evaluations_total = int(evals - evals_base)
+        result.audit_events_total = int(audits - audits_base)
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

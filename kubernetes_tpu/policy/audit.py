@@ -1,0 +1,326 @@
+"""Policy-driven audit pipeline (SURVEY §5.5: levels, RequestReceived →
+ResponseComplete stages).
+
+Parity target: `staging/src/k8s.io/apiserver/pkg/audit` + the
+audit.k8s.io Policy file shape:
+
+    apiVersion: audit.k8s.io/v1
+    kind: Policy
+    rules:
+    - level: None
+      users: ["system:kube-proxy"]
+    - level: RequestResponse
+      verbs: ["create", "update"]
+      resources: ["pods"]
+    - level: Metadata
+
+First matching rule wins (the reference's policy checker); no match =
+level None. Levels gate how much of the request rides the event:
+Metadata = who/what/when + response code; Request adds the request
+object; RequestResponse adds the response object too.
+
+Each audited request emits two stage events sharing one auditID —
+RequestReceived before the rest of the chain runs (so it carries the
+pre-impersonation identity) and ResponseComplete after, carrying the
+response status plus `impersonatedUser` when the impersonation filter
+swapped identities mid-chain.
+
+The sink is a bounded async JSON-lines writer (the reference's buffered
+backend): `emit` never blocks the serving path; overflow drops (counted,
+`audit_events_dropped_total`) rather than backpressuring — the same
+DropIfChannelFull stance as client/events.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import time
+from typing import Any, Mapping
+
+from kubernetes_tpu.metrics.registry import Registry
+
+logger = logging.getLogger(__name__)
+
+LEVEL_NONE = "None"
+LEVEL_METADATA = "Metadata"
+LEVEL_REQUEST = "Request"
+LEVEL_REQUEST_RESPONSE = "RequestResponse"
+
+_LEVEL_ORDER = {LEVEL_NONE: 0, LEVEL_METADATA: 1, LEVEL_REQUEST: 2,
+                LEVEL_REQUEST_RESPONSE: 3}
+
+STAGE_REQUEST_RECEIVED = "RequestReceived"
+STAGE_RESPONSE_COMPLETE = "ResponseComplete"
+
+_audit_seq = itertools.count(1)
+
+
+def level_at_least(level: str, want: str) -> bool:
+    return _LEVEL_ORDER.get(level, 0) >= _LEVEL_ORDER.get(want, 0)
+
+
+class AuditPolicy:
+    """Ordered rules; first match wins. Rule fields (all optional, all
+    must match when present): users, groups, verbs, resources,
+    namespaces. `omitStages` drops stages per rule."""
+
+    _LIST_FIELDS = ("users", "groups", "verbs", "resources",
+                    "namespaces", "omitStages")
+
+    def __init__(self, rules: list[Mapping] | None = None):
+        self.rules = [dict(r) for r in rules or []]
+        for rule in self.rules:
+            for f in self._LIST_FIELDS:
+                v = rule.get(f)
+                if isinstance(v, str):
+                    # A YAML scalar where a list belongs would silently
+                    # degrade `value in want` to SUBSTRING matching.
+                    rule[f] = [v]
+
+    @classmethod
+    def from_dict(cls, doc: Mapping | None) -> "AuditPolicy":
+        return cls((doc or {}).get("rules") or [])
+
+    @classmethod
+    def from_file(cls, path: str) -> "AuditPolicy":
+        import yaml
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    @classmethod
+    def metadata_for_all(cls) -> "AuditPolicy":
+        return cls([{"level": LEVEL_METADATA}])
+
+    @staticmethod
+    def _match(rule: Mapping, value: str | None, field: str) -> bool:
+        want = rule.get(field)
+        if not want:
+            return True
+        return (value or "") in want or "*" in want
+
+    def rule_for(self, *, user: str | None = None,
+                 groups: list[str] | None = None,
+                 verb: str | None = None, resource: str | None = None,
+                 namespace: str | None = None) -> Mapping | None:
+        for rule in self.rules:
+            if not self._match(rule, user, "users"):
+                continue
+            if rule.get("groups") and not any(
+                    g in rule["groups"] for g in groups or []):
+                continue
+            if not self._match(rule, verb, "verbs"):
+                continue
+            if not self._match(rule, resource, "resources"):
+                continue
+            if not self._match(rule, namespace, "namespaces"):
+                continue
+            return rule
+        return None
+
+    def level_for(self, **attrs) -> str:
+        rule = self.rule_for(**attrs)
+        return rule.get("level", LEVEL_NONE) if rule else LEVEL_NONE
+
+
+class AuditSink:
+    """Bounded async JSON-lines writer. With `path=None` events collect
+    in-memory (`self.entries`) — the test/bench sink; with a path they
+    append as one JSON object per line, batched per drain pass."""
+
+    MAX_PENDING = 4096
+    #: in-memory retention cap (path=None): the serving path must not
+    #: grow memory without bound under long runs.
+    MAX_ENTRIES = 100_000
+
+    def __init__(self, path: str | None = None,
+                 registry: Registry | None = None):
+        self.path = path
+        self.entries: list[dict] = []
+        r = registry or Registry()
+        self.registry = r
+        self.events_total = r.counter(
+            "audit_events_total", "Audit stage events emitted",
+            labels=("stage",))
+        self.events_dropped = r.counter(
+            "audit_events_dropped_total",
+            "Audit events dropped on sink overflow")
+        self._pending: list[dict] = []
+        self._draining = False
+        self._closed = False
+
+    def register_into(self, registry: Registry) -> None:
+        for c in (self.events_total, self.events_dropped):
+            registry._metrics.setdefault(c.name, c)
+
+    def emit(self, entry: dict) -> None:
+        """Fire-and-forget enqueue; never blocks the handler chain."""
+        if self._closed:
+            return
+        if len(self._pending) >= self.MAX_PENDING:
+            self.events_dropped.inc()
+            return
+        self.events_total.inc(stage=entry.get("stage", ""))
+        self._pending.append(entry)
+        self._kick()
+
+    def _kick(self) -> None:
+        if self._draining or not self._pending:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # No loop (sync contexts): drain inline to the memory sink so
+            # nothing silently sits forever; file sinks flush on close.
+            if self.path is None:
+                self._absorb(self._pending)
+                self._pending = []
+            return
+        self._draining = True
+        asyncio.ensure_future(self._drain())
+
+    def _absorb(self, batch: list[dict]) -> None:
+        self.entries.extend(batch)
+        if len(self.entries) > self.MAX_ENTRIES:
+            del self.entries[:len(self.entries) - self.MAX_ENTRIES]
+
+    async def _drain(self) -> None:
+        try:
+            while self._pending:
+                batch, self._pending = self._pending, []
+                if self.path is None:
+                    self._absorb(batch)
+                    continue
+                try:
+                    lines = "".join(
+                        json.dumps(e, separators=(",", ":")) + "\n"
+                        for e in batch)
+                    # One buffered append per batch; the event loop eats
+                    # a short write rather than a thread handoff per line.
+                    with open(self.path, "a") as f:
+                        f.write(lines)
+                except OSError:
+                    logger.exception("audit sink write failed "
+                                     "(%d events lost)", len(batch))
+                    self.events_dropped.inc(len(batch))
+                await asyncio.sleep(0)  # yield between batches
+        finally:
+            self._draining = False
+
+    async def close(self) -> None:
+        """Flush whatever is still buffered, then refuse new events."""
+        for _ in range(100):
+            if not self._pending and not self._draining:
+                break
+            self._kick()
+            await asyncio.sleep(0.01)
+        self._closed = True
+        if self._pending:
+            # Drain task never caught up (slow disk, dying loop): flush
+            # the tail inline — and if even that fails, the loss is
+            # COUNTED, never silent (the module's drop contract).
+            batch, self._pending = self._pending, []
+            if self.path is None:
+                self._absorb(batch)
+            else:
+                try:
+                    with open(self.path, "a") as f:
+                        f.write("".join(
+                            json.dumps(e, separators=(",", ":")) + "\n"
+                            for e in batch))
+                except OSError:
+                    logger.exception("audit sink close lost %d events",
+                                     len(batch))
+                    self.events_dropped.inc(len(batch))
+
+
+class AuditPipeline:
+    """Policy + sink + stage-event construction, shared by the HTTP
+    middleware, the KTPU wire handler, and the gRPC interceptor."""
+
+    def __init__(self, policy: AuditPolicy | None = None,
+                 sink: AuditSink | None = None,
+                 registry: Registry | None = None):
+        self.policy = policy or AuditPolicy()
+        self.sink = sink or AuditSink(registry=registry)
+
+    def register_into(self, registry: Registry) -> None:
+        self.sink.register_into(registry)
+
+    # -- stage events ------------------------------------------------------
+
+    _RULE_UNSET = object()
+
+    def begin(self, *, user: str, groups: list[str] | None = None,
+              verb: str, resource: str, namespace: str | None = None,
+              name: str | None = None, request_object: Any = None,
+              rule: Any = _RULE_UNSET) -> dict | None:
+        """Emit RequestReceived; returns the audit context to finish with
+        response_complete(), or None when the policy says level None
+        (nothing more to do for this request). Callers that already
+        matched the policy (to decide whether to capture the body) pass
+        the rule in — the scan must not run twice per request."""
+        if rule is self._RULE_UNSET:
+            rule = self.policy.rule_for(user=user, groups=groups,
+                                        verb=verb, resource=resource,
+                                        namespace=namespace)
+        level = rule.get("level", LEVEL_NONE) if rule else LEVEL_NONE
+        if level == LEVEL_NONE:
+            return None
+        omit = set((rule or {}).get("omitStages") or ())
+        ctx = {
+            "kind": "Event", "apiVersion": "audit.k8s.io/v1",
+            "auditID": f"audit-{next(_audit_seq):x}",
+            "level": level,
+            "verb": verb,
+            "user": {"username": user, "groups": list(groups or [])},
+            "objectRef": {"resource": resource,
+                          "namespace": namespace or "",
+                          "name": name or ""},
+        }
+        if level_at_least(level, LEVEL_REQUEST) and \
+                request_object is not None:
+            ctx["requestObject"] = request_object
+        if STAGE_REQUEST_RECEIVED not in omit:
+            self.sink.emit({**ctx, "stage": STAGE_REQUEST_RECEIVED,
+                            "stageTimestamp": _now()})
+        ctx["_omit"] = omit
+        return ctx
+
+    def response_complete(self, ctx: dict | None, *, code: int,
+                          response_object: Any = None,
+                          impersonated_user: str | None = None,
+                          request_object: Any = None) -> None:
+        """Emit ResponseComplete for a begin()-opened context. Records
+        both identities when impersonation happened mid-chain: `user`
+        stays the authenticated (original) principal, `impersonatedUser`
+        is who the request ran as."""
+        if ctx is None:
+            return
+        omit = ctx.pop("_omit", set())
+        if STAGE_RESPONSE_COMPLETE in omit:
+            return
+        entry = {k: v for k, v in ctx.items() if not k.startswith("_")}
+        entry["stage"] = STAGE_RESPONSE_COMPLETE
+        entry["stageTimestamp"] = _now()
+        entry["responseStatus"] = {"code": code}
+        if impersonated_user:
+            entry["impersonatedUser"] = {"username": impersonated_user}
+        level = ctx.get("level", LEVEL_NONE)
+        if level_at_least(level, LEVEL_REQUEST) and \
+                request_object is not None and \
+                "requestObject" not in entry:
+            entry["requestObject"] = request_object
+        if level_at_least(level, LEVEL_REQUEST_RESPONSE) and \
+                response_object is not None:
+            entry["responseObject"] = response_object
+        self.sink.emit(entry)
+
+    async def close(self) -> None:
+        await self.sink.close()
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

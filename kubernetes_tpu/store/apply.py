@@ -228,3 +228,52 @@ async def server_side_apply(store, resource: str, obj: Mapping, *,
         except Conflict:
             continue  # CAS retry against the newer version
     raise Conflict(f"{resource} {key!r}: too many apply retries")
+
+
+# ---------------------------------------------------------------------------
+# kubectl patch: strategic-merge / merge patch
+# ---------------------------------------------------------------------------
+
+#: patchMergeKey per list field (apimachinery strategic-merge tags): lists
+#: of objects under these keys merge entry-by-entry on the key instead of
+#: replacing wholesale.
+_MERGE_KEYS = {"containers": "name", "initContainers": "name",
+               "tolerations": "key", "env": "name", "ports": "containerPort",
+               "volumes": "name", "volumeMounts": "mountPath"}
+
+
+def strategic_merge_patch(current: Mapping, patch: Mapping, *,
+                          strategic: bool = True) -> dict:
+    """RFC-7386 merge patch, plus the strategic keyed-list merge subset
+    (`kubectl patch` default): dicts merge recursively, explicit null
+    deletes, lists replace — except, when `strategic`, lists of objects
+    under a known patchMergeKey field merge per entry on that key."""
+
+    def merge(cur, pat, field=""):
+        if isinstance(cur, Mapping) and isinstance(pat, Mapping):
+            out = dict(cur)
+            for k, v in pat.items():
+                if v is None:
+                    out.pop(k, None)
+                elif k in out:
+                    out[k] = merge(out[k], v, k)
+                else:
+                    out[k] = copy.deepcopy(v)
+            return out
+        if strategic and isinstance(cur, list) and isinstance(pat, list):
+            mk = _MERGE_KEYS.get(field)
+            if mk and all(isinstance(e, Mapping) and mk in e
+                          for e in [*cur, *pat]):
+                out = [copy.deepcopy(e) for e in cur]
+                index = {e[mk]: i for i, e in enumerate(out)}
+                for e in pat:
+                    i = index.get(e[mk])
+                    if i is None:
+                        index[e[mk]] = len(out)
+                        out.append(copy.deepcopy(e))
+                    else:
+                        out[i] = merge(out[i], e)
+                return out
+        return copy.deepcopy(pat)
+
+    return merge(dict(current), patch)

@@ -97,10 +97,47 @@ def default_pod(pod: dict) -> None:
 DEFAULT_CLASS_ANN = "storageclass.kubernetes.io/is-default-class"
 
 
+def validate_admission_policy(policy: dict) -> None:
+    """ValidatingAdmissionPolicy: every expression must COMPILE inside
+    the sandbox grammar at write time (the reference typechecks CEL at
+    admission of the policy object, not at first use)."""
+    _validate_meta(policy, "ValidatingAdmissionPolicy", namespaced=False)
+    spec = policy.get("spec") or {}
+    if spec.get("failurePolicy") not in (None, "Fail", "Ignore"):
+        raise Invalid("ValidatingAdmissionPolicy: failurePolicy must be "
+                      "Fail or Ignore")
+    validations = spec.get("validations")
+    if not validations:
+        raise Invalid("ValidatingAdmissionPolicy: spec.validations must "
+                      "be non-empty")
+    from kubernetes_tpu.policy.expr import (
+        ExpressionError,
+        compile_expression,
+    )
+    for i, v in enumerate(validations):
+        try:
+            compile_expression(v.get("expression", ""))
+        except ExpressionError as e:
+            raise Invalid(f"ValidatingAdmissionPolicy: "
+                          f"spec.validations[{i}]: {e}") from e
+
+
+def validate_vap_binding(binding: dict) -> None:
+    _validate_meta(binding, "ValidatingAdmissionPolicyBinding",
+                   namespaced=False)
+    if not (binding.get("spec") or {}).get("policyName"):
+        raise Invalid("ValidatingAdmissionPolicyBinding: spec.policyName "
+                      "is required")
+
+
 def install_core_validation(store) -> None:
     store.register_mutator("pods", default_pod)
     store.register_validator("pods", validate_pod)
     store.register_validator("nodes", validate_node)
+    store.register_validator("validatingadmissionpolicies",
+                             validate_admission_policy)
+    store.register_validator("validatingadmissionpolicybindings",
+                             validate_vap_binding)
 
     def default_storage_class(pvc: dict) -> None:
         """DefaultStorageClass admission (plugin/pkg/admission/storage/

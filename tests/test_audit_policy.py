@@ -1,0 +1,371 @@
+"""Audit policy engine (policy/audit.py): rule matching, level-gated
+bodies (Metadata vs Request vs RequestResponse), RequestReceived →
+ResponseComplete stages on both wires + the gRPC interceptor chain,
+RBAC-gated impersonation (allowed and denied), and the bounded sink."""
+
+import asyncio
+import json
+
+import pytest
+
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.apiserver.client import RemoteStore
+from kubernetes_tpu.apiserver.rbac import RBACAuthorizer
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+from kubernetes_tpu.policy.audit import (
+    AuditPipeline,
+    AuditPolicy,
+    AuditSink,
+    LEVEL_METADATA,
+    LEVEL_NONE,
+    LEVEL_REQUEST_RESPONSE,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.store.mvcc import StoreError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestPolicyRules:
+    def test_first_match_wins_and_default_none(self):
+        pol = AuditPolicy([
+            {"level": "None", "users": ["system:kube-proxy"]},
+            {"level": "RequestResponse", "verbs": ["create"],
+             "resources": ["pods"]},
+            {"level": "Metadata", "resources": ["pods", "nodes"]},
+        ])
+        assert pol.level_for(user="system:kube-proxy", verb="create",
+                             resource="pods") == LEVEL_NONE
+        assert pol.level_for(user="alice", verb="create",
+                             resource="pods") == LEVEL_REQUEST_RESPONSE
+        assert pol.level_for(user="alice", verb="get",
+                             resource="nodes") == LEVEL_METADATA
+        # no rule matches → None (the reference default)
+        assert pol.level_for(user="alice", verb="get",
+                             resource="secrets") == LEVEL_NONE
+
+    def test_group_and_namespace_rules(self):
+        pol = AuditPolicy([
+            {"level": "Metadata", "groups": ["system:nodes"]},
+            {"level": "Request", "namespaces": ["prod"]},
+        ])
+        assert pol.level_for(user="u", groups=["system:nodes"],
+                             verb="get", resource="pods") == "Metadata"
+        assert pol.level_for(user="u", groups=[], verb="get",
+                             resource="pods",
+                             namespace="prod") == "Request"
+
+
+class _Cluster:
+    """Store + HTTP + wire sharing ONE audit pipeline (for_apiserver)."""
+
+    def __init__(self, policy_rules, **api_kw):
+        self.store = new_cluster_store()
+        install_core_validation(self.store)
+        self.audit = AuditPipeline(AuditPolicy(policy_rules))
+        self.api = APIServer(self.store, audit=self.audit, **api_kw)
+        self.wire = None
+
+    async def __aenter__(self):
+        await self.api.start()
+        self.wire = WireServer.for_apiserver(self.api, host="unix:")
+        await self.wire.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.wire.stop()
+        await self.api.stop()
+        self.store.stop()
+
+    def entries(self, resource="pods"):
+        return [e for e in self.audit.sink.entries
+                if e["objectRef"]["resource"] == resource]
+
+
+class TestLevelFiltering:
+    def test_metadata_vs_requestresponse_bodies(self):
+        """The satellite's level-filtering scenario: a Metadata-level
+        rule audits who/what/when with NO bodies; RequestResponse
+        carries both the request and response objects."""
+        async def body():
+            rules = [
+                {"level": "RequestResponse", "resources": ["pods"],
+                 "namespaces": ["deep"]},
+                {"level": "Metadata", "resources": ["pods"]},
+            ]
+            async with _Cluster(rules) as c:
+                rs = RemoteStore(c.api.url)
+                await rs.create("pods", make_pod("meta-pod"))
+                await rs.create("pods", make_pod("deep-pod",
+                                                 namespace="deep"))
+                await asyncio.sleep(0.05)
+                by_name = {}
+                for e in c.entries():
+                    by_name.setdefault(
+                        e["objectRef"]["name"] or "?", []).append(e)
+                meta = [e for e in by_name["meta-pod"]
+                        if e["stage"] == "ResponseComplete"][0]
+                assert meta["level"] == "Metadata"
+                assert "requestObject" not in meta
+                assert "responseObject" not in meta
+                assert meta["responseStatus"]["code"] == 201
+                deep_rr = [e for e in by_name["deep-pod"]
+                           if e["stage"] == "RequestReceived"][0]
+                assert deep_rr["requestObject"]["metadata"]["name"] == \
+                    "deep-pod"
+                deep_rc = [e for e in by_name["deep-pod"]
+                           if e["stage"] == "ResponseComplete"][0]
+                # Response object carries the SERVER-assigned fields.
+                assert deep_rc["responseObject"]["metadata"][
+                    "resourceVersion"]
+                await rs.close()
+        run(body())
+
+    def test_level_none_emits_nothing(self):
+        async def body():
+            rules = [{"level": "None", "users": ["system:anonymous"]},
+                     {"level": "Metadata"}]
+            async with _Cluster(rules) as c:
+                rs = RemoteStore(c.api.url)
+                await rs.create("pods", make_pod("quiet"))
+                await asyncio.sleep(0.05)
+                assert c.entries() == []
+                await rs.close()
+        run(body())
+
+    def test_stages_on_the_wire_share_audit_id(self):
+        async def body():
+            async with _Cluster([{"level": "Metadata"}]) as c:
+                wc = WireStore(c.wire.target)
+                await wc.create("pods", make_pod("w"))
+                await wc.get("pods", "default/w")
+                await asyncio.sleep(0.05)
+                evs = c.entries()
+                creates = [e for e in evs
+                           if e["verb"] == "create"]
+                assert [e["stage"] for e in creates] == \
+                    ["RequestReceived", "ResponseComplete"]
+                assert creates[0]["auditID"] == creates[1]["auditID"]
+                assert creates[1]["responseStatus"]["code"] == 201
+                gets = [e for e in evs if e["verb"] == "get"]
+                assert {e["stage"] for e in gets} == \
+                    {"RequestReceived", "ResponseComplete"}
+                await wc.close()
+        run(body())
+
+    def test_denied_request_audited_with_failure_code(self):
+        async def body():
+            authz = RBACAuthorizer()  # empty: deny-by-default
+            async with _Cluster([{"level": "Metadata"}],
+                                authorizer=authz) as c:
+                rs = RemoteStore(c.api.url)
+                with pytest.raises(StoreError):
+                    await rs.create("pods", make_pod("denied"))
+                wc = WireStore(c.wire.target)
+                with pytest.raises(StoreError):
+                    await wc.create("pods", make_pod("denied2"))
+                await asyncio.sleep(0.05)
+                codes = [e["responseStatus"]["code"]
+                         for e in c.entries()
+                         if e["stage"] == "ResponseComplete"]
+                assert codes == [403, 403]
+                await wc.close()
+                await rs.close()
+        run(body())
+
+
+def _imp_authz():
+    authz = RBACAuthorizer()
+    authz.add_role({"metadata": {"name": "imp"},
+                    "rules": [{"verbs": ["impersonate"],
+                               "resources": ["users"]}]})
+    authz.add_role({"metadata": {"name": "podw"},
+                    "rules": [{"verbs": ["*"], "resources": ["pods"]}]})
+    authz.add_binding({"roleRef": {"name": "imp"},
+                       "subjects": [{"kind": "User", "name": "admin"}]})
+    authz.add_binding({"roleRef": {"name": "podw"},
+                       "subjects": [{"kind": "User", "name": "bob"}]})
+    return authz
+
+
+class TestImpersonationRBAC:
+    def test_http_allowed_denied_and_audited(self):
+        async def body():
+            tokens = {"ta": "admin", "tm": "mallory"}
+            async with _Cluster([{"level": "Metadata"}],
+                                bearer_tokens=tokens,
+                                authorizer=_imp_authz()) as c:
+                # Allowed: admin → bob; attributed to bob, original kept.
+                rs = RemoteStore(c.api.url, token="ta",
+                                 impersonate="bob")
+                await rs.create("pods", make_pod("via-bob"))
+                # Denied: mallory lacks the impersonate verb → 403, and
+                # bob's pod rights never apply.
+                rm = RemoteStore(c.api.url, token="tm",
+                                 impersonate="bob")
+                with pytest.raises(StoreError) as ei:
+                    await rm.create("pods", make_pod("nope"))
+                assert "cannot impersonate" in str(ei.value)
+                await asyncio.sleep(0.05)
+                ok = [e for e in c.entries()
+                      if e["objectRef"]["name"] == "via-bob"
+                      and e["stage"] == "ResponseComplete"][0]
+                assert ok["user"]["username"] == "admin"
+                assert ok["impersonatedUser"]["username"] == "bob"
+                await rs.close()
+                await rm.close()
+        run(body())
+
+    def test_wire_allowed_denied(self):
+        async def body():
+            tokens = {"ta": "admin", "tm": "mallory"}
+            async with _Cluster([{"level": "Metadata"}],
+                                bearer_tokens=tokens,
+                                authorizer=_imp_authz()) as c:
+                wc = WireStore(c.wire.target, token="ta",
+                               impersonate="bob")
+                await wc.create("pods", make_pod("w-bob"))
+                wm = WireStore(c.wire.target, token="tm",
+                               impersonate="bob")
+                with pytest.raises(StoreError) as ei:
+                    await wm.create("pods", make_pod("nope"))
+                assert "cannot impersonate" in str(ei.value)
+                await asyncio.sleep(0.05)
+                ok = [e for e in c.entries()
+                      if e["objectRef"]["name"] == "w-bob"
+                      and e["stage"] == "ResponseComplete"][0]
+                assert ok["user"]["username"] == "admin"
+                assert ok["impersonatedUser"]["username"] == "bob"
+                await wc.close()
+                await wm.close()
+        run(body())
+
+    def test_impersonate_group_needs_its_own_grant(self):
+        """impersonate-on-users must NOT allow self-assigned groups:
+        the reference gates each impersonated attribute separately."""
+        async def body():
+            import aiohttp
+            async with _Cluster([{"level": "Metadata"}],
+                                bearer_tokens={"ta": "admin"},
+                                authorizer=_imp_authz()) as c:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                            c.api.url + "/api/v1/namespaces/default/pods",
+                            json=make_pod("x"),
+                            headers={"Authorization": "Bearer ta",
+                                     "Impersonate-User": "bob",
+                                     "Impersonate-Group":
+                                         "cluster-admins"}) as r:
+                        assert r.status == 403
+                        assert "cannot impersonate groups" in \
+                            (await r.json())["message"]
+        run(body())
+
+    def test_wire_second_hello_refused(self):
+        """One handshake per connection: a second hello must not reset
+        the audited principal or re-authenticate the session."""
+        async def body():
+            async with _Cluster([{"level": "Metadata"}],
+                                bearer_tokens={"ta": "admin"},
+                                authorizer=_imp_authz()) as c:
+                wc = WireStore(c.wire.target, token="ta",
+                               impersonate="bob")
+                await wc.create("pods", make_pod("first"))
+                fut = asyncio.get_event_loop().create_future()
+                wc._pending["h2"] = fut
+                wc._send(["h2", "hello", {"token": None}])
+                with pytest.raises(StoreError) as ei:
+                    await asyncio.wait_for(fut, 5)
+                assert "already authenticated" in str(ei.value)
+                await wc.close()
+        run(body())
+
+    def test_grpc_interceptor_chain(self):
+        """The third wire: authn → audit → impersonation → authz as a
+        grpc.aio server interceptor."""
+        async def body():
+            from kubernetes_tpu.apiserver.grpc_server import (
+                GRPCAPIServer,
+                GRPCRemoteStore,
+            )
+            store = new_cluster_store()
+            install_core_validation(store)
+            audit = AuditPipeline(AuditPolicy.metadata_for_all())
+            srv = GRPCAPIServer(
+                store, bearer_tokens={"ta": "admin", "tm": "mallory"},
+                authorizer=_imp_authz(), audit=audit)
+            await srv.start()
+            clients = []
+            try:
+                ok = GRPCRemoteStore(srv.target, token="ta",
+                                     impersonate="bob")
+                clients.append(ok)
+                created = await ok.create("pods", make_pod("g-bob"))
+                assert created["metadata"]["name"] == "g-bob"
+                # admin direct: no pod rights → PERMISSION_DENIED maps
+                # to StoreError.
+                direct = GRPCRemoteStore(srv.target, token="ta")
+                clients.append(direct)
+                with pytest.raises(StoreError):
+                    await direct.create("pods", make_pod("nope"))
+                # mallory cannot impersonate.
+                bad = GRPCRemoteStore(srv.target, token="tm",
+                                      impersonate="bob")
+                clients.append(bad)
+                with pytest.raises(StoreError) as ei:
+                    await bad.create("pods", make_pod("nope2"))
+                assert "cannot impersonate" in str(ei.value)
+                # bad token → unauthenticated.
+                anon = GRPCRemoteStore(srv.target, token="wrong")
+                clients.append(anon)
+                with pytest.raises(StoreError):
+                    await anon.get("pods", "default/g-bob")
+                await asyncio.sleep(0.05)
+                done = [e for e in audit.sink.entries
+                        if e["stage"] == "ResponseComplete"
+                        and e["objectRef"]["name"] == "g-bob"]
+                assert done and done[0]["user"]["username"] == "admin"
+                assert done[0]["impersonatedUser"]["username"] == "bob"
+            finally:
+                for cli in clients:
+                    await cli.close()
+                await srv.stop()
+                store.stop()
+        run(body())
+
+
+class TestSink:
+    def test_bounded_sink_drops_and_counts(self):
+        async def body():
+            sink = AuditSink()
+            sink.MAX_PENDING = 8
+            # No drain between emits: everything lands in one tick...
+            for i in range(20):
+                sink.emit({"stage": "ResponseComplete", "i": i})
+            assert sink.events_dropped.value() == 12
+            await asyncio.sleep(0.05)
+            assert len(sink.entries) == 8
+            await sink.close()
+        run(body())
+
+    def test_file_sink_writes_json_lines(self, tmp_path):
+        async def body():
+            path = tmp_path / "audit.log"
+            sink = AuditSink(path=str(path))
+            pipeline = AuditPipeline(AuditPolicy.metadata_for_all(),
+                                     sink=sink)
+            ctx = pipeline.begin(user="u", verb="create",
+                                 resource="pods", namespace="default",
+                                 name="p")
+            pipeline.response_complete(ctx, code=201)
+            await asyncio.sleep(0.05)
+            await pipeline.close()
+            lines = [json.loads(ln) for ln in
+                     path.read_text().splitlines()]
+            assert [e["stage"] for e in lines] == [
+                "RequestReceived", "ResponseComplete"]
+            assert lines[1]["responseStatus"]["code"] == 201
+        run(body())

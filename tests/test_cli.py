@@ -178,6 +178,115 @@ class TestOverHTTP:
         run(body())
 
 
+class TestCreatePatch:
+    def test_create_f_over_http(self, tmp_path):
+        """kubectl create -f against the LIVE HTTP server: created once,
+        AlreadyExists on repeat (create is not apply)."""
+        async def body():
+            from kubernetes_tpu.apiserver.client import RemoteStore
+            from kubernetes_tpu.apiserver.server import APIServer
+            store = new_cluster_store()
+            install_core_validation(store)
+            srv = APIServer(store)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            manifest = tmp_path / "p.yaml"
+            manifest.write_text(yaml.safe_dump(
+                {"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "made"},
+                 "spec": {"containers": [{"name": "c", "image": "x"}]}}))
+            rc, out = await _cli(rs, "create", "-f", str(manifest))
+            assert rc == 0 and "pods/made created" in out
+            assert (await store.get("pods", "default/made"))
+            rc, _ = await _cli(rs, "create", "-f", str(manifest))
+            assert rc == 1  # AlreadyExists → error, unlike apply
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_patch_strategic_merge_over_http_flows_policy_chain(self):
+        """kubectl patch over the live server: strategic merge on the
+        server side, and the merged result runs the expression-policy
+        admission chain — a patch that violates a policy is rejected."""
+        async def body():
+            from kubernetes_tpu.api.types import (
+                make_validating_admission_policy,
+                make_vap_binding,
+            )
+            from kubernetes_tpu.apiserver.admission import (
+                WebhookAdmission,
+            )
+            from kubernetes_tpu.apiserver.client import RemoteStore
+            from kubernetes_tpu.apiserver.server import APIServer
+            from kubernetes_tpu.policy import PolicyEngine
+            store = new_cluster_store()
+            install_core_validation(store)
+            adm = WebhookAdmission(store,
+                                   policy_engine=PolicyEngine(store))
+            srv = APIServer(store, admission=adm)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            await store.create("pods", make_pod(
+                "web", labels={"app": "web"}))
+            # Strategic merge: containers merge by name, labels merge.
+            rc, out = await _cli(
+                rs, "patch", "pods", "web", "-p",
+                '{"metadata": {"labels": {"tier": "fe"}},'
+                ' "spec": {"containers":'
+                ' [{"name": "main", "image": "app:2"}]}}')
+            assert rc == 0 and "patched" in out
+            got = await store.get("pods", "default/web")
+            assert got["metadata"]["labels"] == {"app": "web",
+                                                 "tier": "fe"}
+            assert [c["image"] for c in got["spec"]["containers"]] == \
+                ["app:2"]
+            # A policy forbidding priority>100 rejects a violating patch.
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("prio-cap", [
+                    {"expression": "not has(object.spec.priority) or "
+                                   "object.spec.priority <= 100",
+                     "message": "priority capped at 100"}]))
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("prio-cap-b", "prio-cap"))
+            rc, _ = await _cli(rs, "patch", "pods", "web", "-p",
+                               '{"spec": {"priority": 10000}}')
+            assert rc == 1
+            got = await store.get("pods", "default/web")
+            assert "priority" not in got["spec"]
+            rc, _ = await _cli(rs, "patch", "pods", "web", "-p",
+                               '{"spec": {"priority": 50}}')
+            assert rc == 0
+            assert (await store.get(
+                "pods", "default/web"))["spec"]["priority"] == 50
+            await rs.close()
+            await srv.stop()
+            store.stop()
+        run(body())
+
+    def test_patch_in_process_fallback(self):
+        async def body():
+            store = await seeded_store()
+            rc, out = await _cli(
+                store, "patch", "pods", "web-1", "-p",
+                '{"metadata": {"labels": {"x": "1"}}}')
+            assert rc == 0 and "patched" in out
+            got = await store.get("pods", "default/web-1")
+            assert got["metadata"]["labels"]["x"] == "1"
+            # merge type: lists replace wholesale.
+            rc, _ = await _cli(
+                store, "patch", "pods", "web-1", "--type", "merge",
+                "-p", '{"spec": {"containers": [{"name": "only",'
+                      ' "image": "y"}]}}')
+            assert rc == 0
+            got = await store.get("pods", "default/web-1")
+            assert [c["name"] for c in got["spec"]["containers"]] == \
+                ["only"]
+            store.stop()
+        run(body())
+
+
 class TestRolloutAndTop:
     def test_rollout_status_restart_history(self):
         async def body():

@@ -75,11 +75,82 @@ class TestAggregation:
         async def body():
             s = MVCCStore()
             rec = EventRecorder(s, "scheduler")
+            rec.MAX_PENDING = 100  # non-priority bound under test
             # No loop yield between these: the buffer caps the burst.
-            for i in range(3000):
-                rec.event(_pod(f"p{i}"), "Normal", "Scheduled", "bound")
-            assert rec.dropped == 3000 - rec.MAX_PENDING
+            # Distinct objects → no aggregation; distinct reasons → the
+            # spam filter's per-reason budget never empties.
+            for i in range(300):
+                rec.event(_pod(f"p{i}"), "Warning", f"R{i}", "x")
+            assert rec.dropped == 300 - rec.MAX_PENDING
             await asyncio.sleep(0.2)
             evs = (await s.list("events")).items
             assert len(evs) == rec.MAX_PENDING
+        run(body())
+
+
+class TestPriorityAndSpam:
+    def test_scheduled_burst_rides_the_deeper_priority_bound(self):
+        """The 1000-agent shedding fix: a bind burst larger than
+        MAX_PENDING must NOT shed its per-pod "Scheduled" events."""
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            rec.MAX_PENDING = 100
+            for i in range(2000):
+                rec.event(_pod(f"p{i}"), "Normal", "Scheduled", "bound")
+            assert rec.dropped == 0
+            await asyncio.sleep(0.5)
+            evs = (await s.list("events")).items
+            assert len(evs) == 2000
+        run(body())
+
+    def test_spam_filter_sheds_repeating_reason_family(self):
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            rec._spam.burst = 50
+            rec._spam.qps = 0.0  # no refill inside the test window
+            # Distinct objects (no aggregation), one repeating reason.
+            for i in range(200):
+                rec.event(_pod(f"p{i}"), "Warning", "FailedScheduling",
+                          "0/3 nodes")
+            assert rec.spam_filtered == 150
+            assert rec.dropped == 150
+            # The filter is per-reason: another family still has budget.
+            rec.event(_pod("q"), "Normal", "Pulled", "ok")
+            assert rec.spam_filtered == 150
+        run(body())
+
+    def test_priority_event_evicts_buffered_noise_when_full(self):
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            rec.MAX_PENDING = 10
+            rec.MAX_PENDING_PRIORITY = 10  # force the shared-bound path
+            for i in range(10):
+                rec.event(_pod(f"n{i}"), "Warning", f"Noise{i}", "x")
+            assert len(rec._pending) == 10
+            rec.event(_pod("s"), "Normal", "Scheduled", "bound")
+            # One noise event evicted (counted dropped); Scheduled is in.
+            assert rec.dropped == 1
+            reasons = [e["reason"] for e in rec._pending]
+            assert "Scheduled" in reasons and len(reasons) == 10
+        run(body())
+
+    def test_drain_writes_priority_first(self):
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            # Build the batch with no loop running, then drain once.
+            rec.event(_pod("a"), "Warning", "Noise", "x")
+            rec.event(_pod("b"), "Normal", "Scheduled", "bound")
+            rec.event(_pod("c"), "Warning", "Noise2", "x")
+            rec.event(_pod("d"), "Normal", "Scheduled", "bound")
+            await asyncio.sleep(0.1)
+            evs = (await s.list("events")).items
+            evs.sort(key=lambda e:
+                     int(e["metadata"]["resourceVersion"]))
+            reasons = [e["reason"] for e in evs]
+            assert reasons == ["Scheduled", "Scheduled", "Noise",
+                               "Noise2"]
         run(body())

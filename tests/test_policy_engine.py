@@ -1,0 +1,388 @@
+"""Policy engine subsystem (kubernetes_tpu/policy): the sandboxed
+expression evaluator, ValidatingAdmissionPolicy + bindings on BOTH
+wires, failurePolicy semantics, param resolution, match constraints,
+and the reference handler-chain order (authn → audit → impersonation →
+APF → authz) on both wires."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    make_config_map,
+    make_namespace,
+    make_pod,
+    make_validating_admission_policy,
+    make_vap_binding,
+)
+from kubernetes_tpu.apiserver.admission import WebhookAdmission
+from kubernetes_tpu.apiserver.client import RemoteStore
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+from kubernetes_tpu.policy import PolicyEngine
+from kubernetes_tpu.policy.expr import (
+    BudgetExceeded,
+    ExpressionError,
+    compile_expression,
+)
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.store.mvcc import Invalid
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# evaluator sandbox
+# ---------------------------------------------------------------------------
+
+def _ev(src, **variables):
+    variables.setdefault("object", {})
+    variables.setdefault("oldObject", None)
+    variables.setdefault("request", {})
+    variables.setdefault("params", None)
+    return compile_expression(src).evaluate(variables)
+
+
+class TestEvaluator:
+    def test_field_access_and_functions(self):
+        pod = make_pod("a", labels={"app": "web"}, priority=5)
+        assert _ev("object.metadata.name == 'a'", object=pod)
+        assert _ev("object.spec.priority < 10", object=pod)
+        assert _ev("object.metadata.labels['app'] in ('web', 'db')",
+                   object=pod)
+        assert _ev("size(object.spec.containers) == 1", object=pod)
+        assert _ev("has(object.spec.priority) and "
+                   "not has(object.spec.nodeName)", object=pod)
+        assert _ev("object.metadata.name.startsWith('a')", object=pod)
+        assert _ev("string(object.spec.priority) == '5'", object=pod)
+        assert _ev("all(c.name != '' "
+                   "for c in object.spec.containers)", object=pod)
+
+    def test_missing_field_is_an_error_unless_has(self):
+        with pytest.raises(ExpressionError):
+            _ev("object.spec.nope == 1", object=make_pod("a"))
+        assert _ev("has(object.spec.nope)", object=make_pod("a")) is False
+
+    def test_attribute_escape_is_impossible(self):
+        """The CEL-analog sandbox invariant: dunder access is rejected at
+        compile time, and attribute access NEVER reaches Python object
+        attributes — it is a mapping lookup only."""
+        for src in ("object.__class__", "object.__dict__.x",
+                    "().__class__.__bases__",
+                    "object._private"):
+            with pytest.raises(ExpressionError):
+                compile_expression(src)
+        # A dict KEY shaped like a method name is data, not a method:
+        # attribute access finds the key, never dict.keys.
+        assert _ev("object.keys == 'v'", object={"keys": "v"})
+        # A genuine dict method name with no such key errors instead of
+        # resolving to the bound method.
+        with pytest.raises(ExpressionError):
+            _ev("object.values == 1", object={"k": "v"})
+
+    def test_forbidden_syntax_rejected_at_compile(self):
+        for src in ("__import__('os')", "open('/etc/passwd')",
+                    "lambda: 1", "object.spec ** 2", "x := 3",
+                    "f'{object}'", "{**object}", "object.spec.run()"):
+            with pytest.raises(ExpressionError):
+                compile_expression(src)
+        # Method objects are unreachable at eval time too: attribute
+        # access on a non-mapping is an error, not a getattr.
+        with pytest.raises(ExpressionError):
+            _ev("[].append == 1")
+
+    def test_cost_budget_bomb_dies(self):
+        """Nested comprehension over a modest list must hit the step
+        budget instead of stalling the apiserver."""
+        items = [{"v": i} for i in range(200)]
+        bomb = ("size([1 for a in object.items for b in object.items "
+                "for c in object.items])")
+        with pytest.raises(BudgetExceeded):
+            _ev(bomb, object={"items": items})
+
+    def test_sequence_repetition_and_huge_concat_bounded(self):
+        with pytest.raises(ExpressionError):
+            _ev("object.s * 100000", object={"s": "a" * 100})
+        big = "x" * 60000
+        with pytest.raises(BudgetExceeded):
+            _ev("object.a + object.a", object={"a": big})
+
+    def test_matches_bounded(self):
+        assert _ev("object.name.matches('^web-[0-9]+$')",
+                   object={"name": "web-3"})
+        with pytest.raises(BudgetExceeded):
+            _ev("object.name.matches(object.pat)",
+                object={"name": "a", "pat": "x" * 1000})
+
+
+# ---------------------------------------------------------------------------
+# VAP over both wires
+# ---------------------------------------------------------------------------
+
+async def _policy_cluster(**api_kw):
+    store = new_cluster_store()
+    install_core_validation(store)
+    engine = PolicyEngine(store)
+    adm = WebhookAdmission(store, policy_engine=engine)
+    api = APIServer(store, admission=adm, **api_kw)
+    await api.start()
+    wire = WireServer.for_apiserver(api, host="unix:")
+    await wire.start()
+    return store, engine, api, wire
+
+
+class TestValidatingAdmissionPolicy:
+    def test_policy_rejects_pod_on_both_wires_with_message(self):
+        """The acceptance-criteria scenario: a VAP stored via the API
+        rejects a matching pod on BOTH wires, message in the Status."""
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            rs = RemoteStore(api.url)
+            # Stored VIA THE API, like any resource.
+            await rs.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("deny-gpu", [
+                    {"expression":
+                         "all(not has(c.resources.limits)"
+                         " or 'gpu' not in c.resources.limits"
+                         " for c in object.spec.containers)",
+                     "message": "gpu containers are forbidden here"}],
+                    match_constraints={"resourceRules": [
+                        {"resources": ["pods"],
+                         "operations": ["CREATE"]}]}))
+            await rs.create("validatingadmissionpolicybindings",
+                            make_vap_binding("deny-gpu-b", "deny-gpu"))
+            bad = make_pod("gpu-pod", limits={"gpu": "1"})
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", bad)
+            assert "gpu containers are forbidden here" in str(ei.value)
+            c = WireStore(wire.target)
+            with pytest.raises(Invalid) as ei:
+                await c.create("pods", make_pod("gpu2", limits={"gpu": "1"}))
+            assert "gpu containers are forbidden here" in str(ei.value)
+            # Non-matching pods pass, on both wires.
+            assert (await rs.create("pods", make_pod("ok1")))
+            assert (await c.create("pods", make_pod("ok2")))
+            # Operations constraint: UPDATE is outside CREATE-only rules.
+            ok1 = await store.get("pods", "default/ok1")
+            ok1["metadata"]["labels"] = {"x": "1"}
+            await rs.update("pods", ok1)
+            assert engine.rejections.value(policy="deny-gpu") == 2
+            assert engine.evaluations.value(policy="deny-gpu") >= 4
+            await c.close()
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_failure_policy_ignore_skips_broken_policy(self):
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            # Expression errors at runtime (missing field), one policy
+            # per failurePolicy mode.
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("broken-ignore", [
+                    {"expression": "object.spec.doesNotExist == 1"}],
+                    failure_policy="Ignore"))
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("bi", "broken-ignore"))
+            rs = RemoteStore(api.url)
+            assert (await rs.create("pods", make_pod("passes")))
+            # Same breakage with Fail denies.
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("broken-fail", [
+                    {"expression": "object.spec.doesNotExist == 1"}],
+                    failure_policy="Fail"))
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("bf", "broken-fail"))
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", make_pod("denied"))
+            assert "failurePolicy=Fail" in str(ei.value)
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_param_resolution_and_missing_param(self):
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("cap", [
+                    {"expression": "int(object.spec.priority) <= "
+                                   "int(params.data.max)",
+                     "message": "over the cap"}],
+                    param_kind="ConfigMap"))
+            await store.create(
+                "validatingadmissionpolicybindings",
+                make_vap_binding("cap-b", "cap", param_ref={
+                    "name": "caps", "namespace": "default"}))
+            rs = RemoteStore(api.url)
+            # Param missing + failurePolicy=Fail (default) → deny.
+            with pytest.raises(Invalid):
+                await rs.create("pods", make_pod("p0", priority=1))
+            await store.create("configmaps",
+                               make_config_map("caps",
+                                               data={"max": "100"}))
+            assert (await rs.create("pods", make_pod("p1", priority=7)))
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", make_pod("p2", priority=700))
+            assert "over the cap" in str(ei.value)
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_namespace_selector_match_constraint(self):
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            await store.create("namespaces", make_namespace("plain"))
+            prod = make_namespace("prod")
+            prod["metadata"]["labels"] = {"env": "prod"}
+            await store.create("namespaces", prod)
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("prod-only", [
+                    {"expression": "has(object.spec.priority)",
+                     "message": "prod pods need a priority"}],
+                    match_constraints={
+                        "resourceRules": [{"resources": ["pods"]}],
+                        "namespaceSelector": {
+                            "matchLabels": {"env": "prod"}}}))
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("po-b", "prod-only"))
+            rs = RemoteStore(api.url)
+            # Unselected namespace: policy does not apply.
+            assert (await rs.create(
+                "pods", make_pod("free", namespace="plain")))
+            with pytest.raises(Invalid):
+                await rs.create("pods", make_pod("np", namespace="prod"))
+            assert (await rs.create("pods", make_pod(
+                "wp", namespace="prod", priority=3)))
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_bad_expression_rejected_at_policy_write(self):
+        """Store-side validation: a policy whose expression does not
+        compile in the sandbox grammar is rejected at CREATE (the
+        reference typechecks CEL when the policy object is admitted)."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            with pytest.raises(Invalid):
+                await store.create(
+                    "validatingadmissionpolicies",
+                    make_validating_admission_policy("evil", [
+                        {"expression": "__import__('os').system('x')"}]))
+            with pytest.raises(Invalid):
+                await store.create(
+                    "validatingadmissionpolicybindings",
+                    {"kind": "ValidatingAdmissionPolicyBinding",
+                     "metadata": {"name": "nameless"}, "spec": {}})
+            store.stop()
+        run(body())
+
+    def test_unbound_policy_is_inert(self):
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("inert", [
+                    {"expression": "1 == 2", "message": "never"}]))
+            rs = RemoteStore(api.url)
+            assert (await rs.create("pods", make_pod("fine")))
+            assert engine.evaluations.value(policy="inert") == 0
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# chain order, both wires
+# ---------------------------------------------------------------------------
+
+class TestHandlerChainOrder:
+    def test_http_middleware_order_matches_reference(self):
+        """§3.2 DefaultBuildHandlerChain: authn → audit → impersonation
+        → APF → authz (authz innermost)."""
+        store = new_cluster_store()
+        api = APIServer(store)
+        names = [getattr(m, "__name__", "") for m in api.app.middlewares]
+        want = ["_mw_authn", "_mw_audit", "_mw_impersonation",
+                "_mw_priority", "_mw_authz"]
+        idx = [names.index(w) for w in want]
+        assert idx == sorted(idx), names
+        store.stop()
+
+    def test_wire_chain_order_matches_reference(self):
+        assert WireServer.HANDLER_CHAIN == (
+            "authn", "audit", "impersonation", "apf", "authz",
+            "admission")
+
+    def test_audit_sees_original_user_authz_sees_impersonated(self):
+        """Behavioral order pin: audit (outer) records the authenticated
+        principal; authz (inner) runs as the impersonated user — on both
+        wires."""
+        async def body():
+            from kubernetes_tpu.apiserver.rbac import RBACAuthorizer
+            from kubernetes_tpu.policy import AuditPipeline, AuditPolicy
+            authz = RBACAuthorizer()
+            authz.add_role({"metadata": {"name": "imp"},
+                            "rules": [{"verbs": ["impersonate"],
+                                       "resources": ["users"]}]})
+            authz.add_role({"metadata": {"name": "writer"},
+                            "rules": [{"verbs": ["*"],
+                                       "resources": ["pods"]}]})
+            authz.add_binding({"roleRef": {"name": "imp"},
+                               "subjects": [{"kind": "User",
+                                             "name": "admin"}]})
+            authz.add_binding({"roleRef": {"name": "writer"},
+                               "subjects": [{"kind": "User",
+                                             "name": "bob"}]})
+            store = new_cluster_store()
+            install_core_validation(store)
+            # Request level for pods so the HTTP create's objectRef gets
+            # its name from the request body (no name in a POST URL).
+            audit = AuditPipeline(AuditPolicy([
+                {"level": "Request", "resources": ["pods"]},
+                {"level": "Metadata"}]))
+            api = APIServer(store,
+                            bearer_tokens={"t": "admin"},
+                            authorizer=authz, audit=audit)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            # admin alone has NO pod rights; impersonating bob works —
+            # proving authz ran as bob (after impersonation).
+            rs = RemoteStore(api.url, token="t", impersonate="bob")
+            await rs.create("pods", make_pod("h1"))
+            c = WireStore(wire.target, token="t", impersonate="bob")
+            await c.create("pods", make_pod("w1"))
+            await asyncio.sleep(0.05)
+            done = {e["objectRef"]["name"]: e
+                    for e in audit.sink.entries
+                    if e["stage"] == "ResponseComplete"
+                    and e["objectRef"]["resource"] == "pods"}
+            for name in ("h1", "w1"):
+                e = done[name]
+                assert e["user"]["username"] == "admin"  # original
+                assert e["impersonatedUser"]["username"] == "bob"
+                assert e["responseStatus"]["code"] == 201
+            await c.close()
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
