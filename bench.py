@@ -53,6 +53,12 @@ def main(argv=None) -> int:
                          "adaptive tuner picks chunk AND pipeline depth "
                          "from warmup-measured transfer latency and "
                          "dirty-upload ratio (BASELINE.md r6 envelope)")
+    ap.add_argument("--shortlist-k", type=int, default=None,
+                    help="OVERRIDE the solver shortlist width (0 disables "
+                         "the pruned solve — the before/after sweep knob). "
+                         "Default: flagless — the tuner derives K from the "
+                         "chunk width and observed fallback rate, active "
+                         "only when the node count dwarfs the scan width")
     ap.add_argument("--through-apiserver", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="cross the process boundary: workload writes, "
@@ -87,6 +93,11 @@ def main(argv=None) -> int:
                          "batched device backend hangs off this gate "
                          "(--backend tpu is sugar for enabling it)")
     args = ap.parse_args(argv)
+
+    if args.shortlist_k is not None:
+        # Must land before the backend module reads it at import.
+        import os
+        os.environ["KTPU_SHORTLIST_K"] = str(args.shortlist_k)
 
     from kubernetes_tpu.perf.scheduler_perf import PerfRunner
     from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATES

@@ -251,6 +251,25 @@ class SchedulerMetrics:
         self.backend_degradations = r.counter(
             "scheduler_tpu_backend_degradations_total",
             "TPU backend fallbacks to degraded modes", labels=("kind",))
+        #: Solve-side observability (the r8 50k profile's blind spot: the
+        #: device solve runs in XLA's compute threads, invisible to a
+        #: main-thread sampler). Per-chunk wall of the fused solve as the
+        #: consumer sees it, the width of the solver's per-step reduce
+        #: (K + P when the shortlist prunes, N when it doesn't), and the
+        #: shortlist's exactness-fallback accounting — hit rate is
+        #: 1 - fallbacks/pods.
+        self.solve_duration = r.histogram(
+            "scheduler_tpu_solve_seconds",
+            "Device-solve wall time per chunk (dispatch to fetched)")
+        self.solver_scan_width = r.gauge(
+            "scheduler_tpu_solver_scan_width",
+            "Per-step candidate width of the latest chunk's solve")
+        self.solver_shortlist_pods = r.counter(
+            "scheduler_tpu_solver_shortlist_pods_total",
+            "Pods solved through the shortlist-pruned scan")
+        self.solver_shortlist_fallbacks = r.counter(
+            "scheduler_tpu_solver_shortlist_fallbacks_total",
+            "Pods whose shortlist bound check fell back to the full row")
 
     def observe_plugin(self, plugin: str, point: str, seconds: float) -> None:
         self.plugin_duration.observe(seconds, plugin=plugin, extension_point=point)

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from functools import partial
 from typing import Mapping, Sequence
 
@@ -79,6 +80,20 @@ _PIPELINE_DEPTH_OVERRIDE = int(os.environ["KTPU_PIPELINE_DEPTH"]) \
 #: pick, so a wrong warmup guess is never catastrophic).
 _DEFAULT_CHUNK = 1024
 
+#: Shortlist OVERRIDE (sweeps/differential tests): an integer K forces the
+#: shortlist width regardless of the tuner's policy, 0 disables pruning
+#: entirely. Unset = flagless — the AdaptiveTuner derives K from the chunk
+#: width and the observed fallback rate (see its shortlist_k policy).
+_SHORTLIST_K_OVERRIDE = int(os.environ["KTPU_SHORTLIST_K"]) \
+    if os.environ.get("KTPU_SHORTLIST_K") else None
+
+#: Shortlist class slots per chunk (jit-stable pad). Pods sharing
+#: (request row, toleration row, mask row, score-dictionary row) share a
+#: chunk-start score row — template batches have a handful of classes, so
+#: the prefilter computes S rows instead of P. A chunk with more distinct
+#: classes than this keeps the full N-wide scan (counted via scan width).
+SHORTLIST_CLASS_PAD = 8
+
 #: Row-dictionary score wire width: when every host score contribution in
 #: a chunk comes from ≤ SCORE_ROWS_PAD-1 distinct per-signature rows
 #: (template batches — the constraint families' normal case), the wire
@@ -105,12 +120,14 @@ class AdaptiveTuner:
       smaller chunks so the bit-packed uploads pipeline against solves.
 
     Policy (BASELINE.md r6 "adaptive vs manual" table is the recorded
-    envelope; tests/test_tpu_backend.py pins it):
+    envelope; tests/test_tpu_backend.py + tests/test_shortlist_smoke.py
+    pin it):
 
     | regime                      | chunk | depth |
     |-----------------------------|-------|-------|
     | latency-bound, clean masks  | 2048  | 4     |
     | latency-bound, dirty masks  | 1024  | 4     |
+    | local, N ≥ 32768            | 1024  | 2     |
     | local device (any dirtiness)| 1024  | 2     |
 
     Latency-bound (≥ 5 ms/transfer): big chunks halve the number of
@@ -120,23 +137,65 @@ class AdaptiveTuner:
     ~2-transfer pipeline bubble. Local: there is no round trip to
     amortize — 1024 measured best and stable on both clean and dirty
     families (r6 sweep) — and depth beyond 2 just delays verify feedback.
+    The r6 table was tuned on the ≤5k presets; the large-N row (r10)
+    pins the regime the 50k sweep measured: the shortlist scan width is
+    K+P = 2·chunk, so widening the chunk COSTS scan work faster than it
+    amortizes the per-chunk O(N) fixed costs (prefilter + top-k, (P,N)
+    static-score materialization, mask unpack) — 1024 beat both 2048 and
+    512 at N=50k on the CPU container (BASELINE r10). Node count is
+    STRUCTURAL (known at the first assign), so unlike the measured
+    signals this row applies without waiting out the warmup window — the
+    50k preset's chunk and shortlist compile in warmup, never in a
+    measured phase.
 
     The decision lands once, at the first assign() boundary after
     WARMUP_CHUNKS chunks have been observed (one recompile at the new
     chunk width, outside any measured phase that follows the reference
     harness's warmup convention); it re-opens only if the dirty-ratio
     regime flips.
+
+    **Shortlist width** (the r10 pruned solve): K = chunk × boost, active
+    only while the node count dwarfs the scan width (N ≥ 4·(K + chunk) —
+    below that the narrow scan plus prefilter costs more than it saves;
+    the 5k preset measured ~10% behind its full scan at factor 2).
+    K defaults to the chunk width because the sequential-equivalent scan
+    can visit one fresh node per pod: a round-robin workload (uniform
+    nodes — the 50k preset) needs the whole chunk's winners inside one
+    shortlist or every pod past the K-th pays the N-wide fallback. The
+    boost doubles (to ×8 max) at assign() boundaries whenever the
+    observed fallback rate crosses 25% — fallbacks are exact but O(N), so
+    a persistently-missing shortlist must widen or it silently degrades
+    to the unpruned solve plus overhead.
     """
 
     LATENCY_BOUND_S = 5e-3
     DIRTY_RATIO = 0.25
     WARMUP_CHUNKS = 8
+    #: node count from which the large-N chunk row applies.
+    LARGE_N = 32768
+    #: shortlist activates when n_real ≥ FACTOR × (K + chunk). Measured
+    #: on the CPU container (r10): at N=5k / chunk 1024 the pruned width
+    #: (2048) plus the per-chunk prefilter/top-k ran ~10% BEHIND the
+    #: r9-tuned full scan, while at N=50k it is a 3–6× win — the factor
+    #: is set so the 5k headline keeps its full scan and activation
+    #: starts where the width ratio pays (≥4×).
+    SHORTLIST_FACTOR = 4
+    SHORTLIST_MAX_BOOST = 8
+    SHORTLIST_FALLBACK_RATIO = 0.25
+    #: minimum solved pods before the fallback rate is trusted.
+    SHORTLIST_MIN_SAMPLE = 512
 
     def __init__(self):
         self.latency_s: float | None = None
         self.dirty_chunks = 0
         self.total_chunks = 0
         self.decided: tuple[int, int] | None = None
+        #: node count of the latest assign() — structural signal for the
+        #: large-N row and the shortlist policy (set by the backend).
+        self.n_nodes = 0
+        self.shortlist_boost = 1
+        self.solve_pods = 0
+        self.solve_fallbacks = 0
 
     def probe(self) -> float:
         """Median tiny put+fetch round trip (no jit, pure transfer)."""
@@ -157,20 +216,64 @@ class AdaptiveTuner:
             self.dirty_chunks += 1
 
     @classmethod
-    def pick(cls, latency_s: float, dirty_ratio: float) -> tuple[int, int]:
+    def pick(cls, latency_s: float, dirty_ratio: float,
+             n_nodes: int = 0) -> tuple[int, int]:
         """(chunk, pipeline depth) for a measured regime — pure policy."""
         remote = latency_s >= cls.LATENCY_BOUND_S
         dirty = dirty_ratio >= cls.DIRTY_RATIO
+        if not remote and n_nodes >= cls.LARGE_N:
+            # Measured at N=50k on the CPU container (BASELINE r10): the
+            # shortlist scan width is K+P = 2·chunk, so chunk growth
+            # COSTS scan work faster than it amortizes the per-chunk
+            # O(N) prefilter — 2048 → 250 pods/s, 1024 → 419, 512 → 389.
+            # The row pins the measured optimum and, being structural,
+            # lands before warmup (no mid-measured-phase recompile).
+            return 1024, 2
         chunk = (1024 if dirty else 2048) if remote else 1024
         return chunk, 4 if remote else 2
+
+    def observe_solve(self, pods: int, fallbacks: int) -> None:
+        """Shortlist hit-rate sample from one finalized chunk."""
+        self.solve_pods += pods
+        self.solve_fallbacks += fallbacks
+
+    def shortlist_k(self, chunk: int, n_real: int) -> int:
+        """Shortlist width for a chunk, 0 = keep the full N-wide scan."""
+        if _SHORTLIST_K_OVERRIDE is not None:
+            k = _SHORTLIST_K_OVERRIDE
+            return k if 0 < k < n_real else 0
+        k = chunk * self.shortlist_boost
+        if n_real < self.SHORTLIST_FACTOR * (k + chunk):
+            return 0
+        return k
 
     def decide(self) -> tuple[int, int] | None:
         """The (chunk, depth) to apply, or None while still warming up.
         Re-decides when the observed dirty regime flips."""
+        if self.solve_pods >= self.SHORTLIST_MIN_SAMPLE:
+            if self.solve_fallbacks > self.SHORTLIST_FALLBACK_RATIO \
+                    * self.solve_pods \
+                    and self.shortlist_boost < self.SHORTLIST_MAX_BOOST:
+                self.shortlist_boost *= 2
+                logger.info(
+                    "adaptive tuner: shortlist fallback rate %.0f%% "
+                    "-> boost x%d", 100.0 * self.solve_fallbacks
+                    / self.solve_pods, self.shortlist_boost)
+            self.solve_pods = self.solve_fallbacks = 0
         if self.total_chunks < self.WARMUP_CHUNKS:
+            # The large-N row rides a STRUCTURAL signal (node count),
+            # so it applies from the very first assign — the one
+            # recompile lands in warmup, not a measured phase. LOCAL
+            # only: the remote rows depend on the measured dirty ratio,
+            # and committing one pre-warmup would lock in a guess.
+            if self.n_nodes >= self.LARGE_N \
+                    and self.probe() < self.LATENCY_BOUND_S:
+                ratio = self.dirty_chunks / self.total_chunks \
+                    if self.total_chunks else 0.0
+                self.decided = self.pick(self.probe(), ratio, self.n_nodes)
             return self.decided
         ratio = self.dirty_chunks / self.total_chunks
-        pick = self.pick(self.probe(), ratio)
+        pick = self.pick(self.probe(), ratio, self.n_nodes)
         if self.decided is None or pick != self.decided:
             self.decided = pick
         return self.decided
@@ -260,7 +363,7 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
     raise KeyError(plugin_name)
 
 
-@partial(jax.jit, static_argnames=("strategy", "use_spread"))
+@partial(jax.jit, static_argnames=("strategy", "use_spread", "shortlist_k"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                        taint_f_mat, taint_p_mat, static_mask, host_scores,
                        score_rows, score_idx,
@@ -269,7 +372,8 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
                        sp_min_ok, sp_haskey,
                        sp_applies, sp_contrib, perms, gang_onehot,
-                       gang_required, strategy: str, use_spread: bool):
+                       gang_required, sl_reps, sl_class,
+                       strategy: str, use_spread: bool, shortlist_k: int):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -284,7 +388,19 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
 
     pod_pack is (P, 2R+tf+tp) int32: req_q ‖ req_nz_q ‖ untol_f ‖ untol_p.
 
-    Returns (assign (P,), used_pack', fit0 (P,N), taint_ok (P,N)).
+    shortlist_k > 0 switches the solve to the SHORTLIST-PRUNED scans
+    (ops/solver.py): a prefilter computes chunk-start live scores for the
+    chunk's SHORTLIST_CLASS_PAD pod classes (sl_reps = representative pod
+    per class, sl_class = per-pod class index), takes the per-class top-K
+    columns plus the (K+1)-th value as exactness threshold, and the scan
+    re-scores K + P candidate columns per step instead of N — falling
+    back to the full row exactly when the bound check cannot prove the
+    narrow winner global. Assignments are bit-identical to the full scan
+    by construction (tests/test_shortlist_solver.py is the differential
+    guard).
+
+    Returns (assign (P+1,) — last element is the chunk's fallback count —
+    used_pack', fit0 (P,N), taint_ok (P,N), dom_counts').
     """
     # Wire decompression (see _prep_chunk): masks arrive bit-packed
     # uint8 (P, N/8) big-endian, scores float16 — unpack/cast on device
@@ -321,15 +437,43 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     free_q = alloc_q - used_q
     free_pods = alloc_pods - used_pods
     dom_counts2 = dom_counts
+    nfall = jnp.int32(0)
+    if shortlist_k:
+        # Shortlist prefilter: chunk-start live scores per pod CLASS
+        # (S rows, not P — template batches share rows), top-K columns +
+        # the (K+1)-th value as the scans' exactness threshold. Chunk-
+        # start capacity feasibility folds in (capacity only decreases
+        # within a chunk); spread gating deliberately does not (it is
+        # non-monotone and exact in-scan — see the spread solver).
+        sc0 = kernels.chunk_start_scores(
+            alloc_q, used_nz_q, req_nz_q[sl_reps], static_scores[sl_reps],
+            fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+            strategy)
+        rep_feas = mask[sl_reps] & fit0[sl_reps]
+        cand_s, thresh_s = solver.shortlist_prefilter(
+            rep_feas, sc0, shortlist_k)
+        sl_cand = cand_s[sl_class]                              # (P, K)
+        sl_thresh = thresh_s[sl_class]                          # (P,)
+        has_node = jnp.any(mask, axis=1)                        # (P,)
     if use_spread:
         # Spread batches run the identity order only (domain counts and
         # permutations don't commute cheaply); gang masking still applies.
-        a0, dom_counts2 = solver.greedy_assign_rescoring_spread(
-            req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
-            static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
-            w_fit, w_bal, strategy,
-            dom_onehot, cid_onehot, dom_counts, max_skew,
-            sp_min_ok, sp_haskey, sp_applies, sp_contrib)
+        if shortlist_k:
+            a0, dom_counts2, nfall = \
+                solver.greedy_assign_rescoring_spread_shortlist(
+                    req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+                    mask, static_scores, fit_col_w, bal_col_mask, shape_u,
+                    shape_s, w_fit, w_bal, strategy,
+                    dom_onehot, cid_onehot, dom_counts, max_skew,
+                    sp_min_ok, sp_haskey, sp_applies, sp_contrib,
+                    sc0, sl_class, sl_cand, sl_thresh, has_node)
+        else:
+            a0, dom_counts2 = solver.greedy_assign_rescoring_spread(
+                req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+                static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+                w_fit, w_bal, strategy,
+                dom_onehot, cid_onehot, dom_counts, max_skew,
+                sp_min_ok, sp_haskey, sp_applies, sp_contrib)
         assign = solver.gang_filter(a0, gang_onehot, gang_required)
         # Gang-dropped pods bumped the chained counts in-scan (for the
         # constraints they CONTRIBUTE to) — fold them back out so later
@@ -341,10 +485,17 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
             jnp.where(dropped[:, None],
                       dom_onehot[safe] * contrib_d, 0.0), axis=0)
     else:
-        assign = solver.multistart_greedy_assign(
-            req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
-            static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
-            w_fit, w_bal, strategy, perms, gang_onehot, gang_required)
+        if shortlist_k:
+            assign, nfall = solver.multistart_greedy_assign_shortlist(
+                req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+                mask, static_scores, fit_col_w, bal_col_mask, shape_u,
+                shape_s, w_fit, w_bal, strategy, perms, gang_onehot,
+                gang_required, sc0, sl_class, sl_cand, sl_thresh, has_node)
+        else:
+            assign = solver.multistart_greedy_assign(
+                req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+                static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+                w_fit, w_bal, strategy, perms, gang_onehot, gang_required)
 
     # Post-assignment state update (scatter-add of assigned requests).
     # Padding/unassigned rows scatter to a dummy row (index N, dropped).
@@ -356,7 +507,10 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     used_pack2 = used_pack + jnp.zeros(
         (n + 1, used_pack.shape[1]), used_pack.dtype
     ).at[tgt].add(jnp.where(hit[:, None], inc, 0))[:n]
-    return assign, used_pack2, fit0, taint_ok, dom_counts2
+    # The fallback count rides the assign fetch (one transfer, not two):
+    # consumers slice [:p_real] for assignments and [-1] for the count.
+    assign_out = jnp.concatenate([assign, nfall[None]])
+    return assign_out, used_pack2, fit0, taint_ok, dom_counts2
 
 
 class TPUBackend:
@@ -455,6 +609,9 @@ class TPUBackend:
         # host→device transfer costs relay latency regardless of size.
         self._dev_perms_cache: dict[tuple, object] = {}
         self._dev_zero_gang: dict[int, tuple] = {}
+        #: zero (sl_reps, sl_class) pair for chunks solved without the
+        #: shortlist (the jit signature keeps the slots either way).
+        self._dev_zero_sl: dict[int, tuple] = {}
 
     # -- device placement ----------------------------------------------------
 
@@ -1085,7 +1242,7 @@ class TPUBackend:
         so the host verify of chunk k overlaps the device solve of k+1."""
         ctx = self._start(pods, snapshot, fwk)
         for run in self._pipeline(ctx):
-            self._finalize_chunk(run, np.asarray(run["assign_d"]), ctx)
+            self._finalize_chunk(run, self._fetch_assign(run), ctx)
         return ctx.assignments, ctx.diagnostics
 
     async def assign_async(self, pods: Sequence[PodInfo], snapshot: Snapshot,
@@ -1113,7 +1270,7 @@ class TPUBackend:
 
         ctx = self._start(pods, snapshot, fwk)
         for run in self._pipeline(ctx):
-            got = await asyncio.to_thread(np.asarray, run["assign_d"])
+            got = await asyncio.to_thread(self._fetch_assign, run)
             if (got[: run["batch"].p_real] < 0).any():
                 # Solver failures → _finalize_chunk will need the unsat
                 # planes for diagnostics. Fetch them HERE, off-loop and
@@ -1124,6 +1281,22 @@ class TPUBackend:
                 await asyncio.to_thread(self._fetch_diag_planes, run)
             self._finalize_chunk(run, got, ctx)
             yield run["pods"], ctx
+
+    def _fetch_assign(self, run: dict) -> np.ndarray:
+        """Blocking device→host fetch of a chunk's assignments, timed.
+
+        The r8 50k profile showed 98.3% main-thread idle with the cost
+        hidden in XLA's compute threads — this wall (dispatch-to-ready of
+        the fused solve, as seen by the consumer) is the observability
+        for that blind spot: scheduler_tpu_solve_seconds per chunk, plus
+        the solver scan width / shortlist fallback counters extracted
+        from the same fetch in _finalize_chunk."""
+        t0 = time.perf_counter()
+        got = np.asarray(run["assign_d"])
+        run["solve_wall_s"] = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.solve_duration.observe(run["solve_wall_s"])
+        return got
 
     def _pipeline(self, ctx: "_AssignCtx"):
         """Yield dispatched chunk runs in finalize order, keeping up to
@@ -1145,6 +1318,9 @@ class TPUBackend:
         # Adaptive chunk/depth land at assign() boundaries only (a chunk
         # change is one recompile at the new jit width; mid-batch it would
         # thrash the signature). Overrides pin their respective knob.
+        # Node count is a structural signal (the large-N row + shortlist
+        # policy read it) — recorded before the decision.
+        self._tuner.n_nodes = len(snapshot.nodes)
         decision = self._tuner.decide()
         if decision is not None:
             chunk, depth = decision
@@ -1508,10 +1684,19 @@ class TPUBackend:
         feas_memo: dict[tuple, np.ndarray] = {}
         norm_memo: dict[tuple, tuple] = {}
 
+        _pck_memo: dict[int, tuple] = {}
+
         def pod_class_key(i: int) -> tuple:
-            mrow = static_mask[i, : ct.n_real].tobytes() \
-                if static_mask is not None else None
-            return (batch.req_class[i], batch.untol_class[i], mrow)
+            # Memoized: both the score-normalization memos and the
+            # shortlist class build key on it, and the mask-row tobytes
+            # is ~N bytes per call on mask-modified chunks.
+            got = _pck_memo.get(i)
+            if got is None:
+                mrow = static_mask[i, : ct.n_real].tobytes() \
+                    if static_mask is not None else None
+                got = _pck_memo[i] = (
+                    batch.req_class[i], batch.untol_class[i], mrow)
+            return got
 
         def feasible_idx(i: int) -> np.ndarray:
             # Class-level masks: one row per DISTINCT request/toleration
@@ -1724,6 +1909,40 @@ class TPUBackend:
                     self._put(np.zeros((P,), dtype=np.int32)))
             dev_srows, dev_sidx = z
 
+        # Shortlist classes: pods sharing (request row, toleration row,
+        # mask row, score-dictionary row) have bit-identical chunk-start
+        # score rows, so the device prefilter computes one row per CLASS
+        # (template batches: a handful) instead of per pod. A dense host
+        # score plane defeats row sharing (per-pod float rows — hashing
+        # them would cost more than the pruning saves), and more classes
+        # than the pad means a genuinely heterogeneous chunk: both keep
+        # the full N-wide scan for this chunk.
+        shortlist_k = 0
+        sl_reps_np = sl_class_np = None
+        if not scores_modified:
+            k = self._tuner.shortlist_k(P, ct.n_real)
+            if k:
+                sl_class_np = np.zeros((P,), dtype=np.int32)
+                reps: list[int] | None = []
+                cls_map: dict[tuple, int] = {}
+                for i in range(batch.p_real):
+                    ckey = (pod_class_key(i),
+                            int(score_idx_np[i])
+                            if score_idx_np is not None else 0)
+                    c = cls_map.get(ckey)
+                    if c is None:
+                        if len(reps) >= SHORTLIST_CLASS_PAD:
+                            reps = None
+                            break
+                        c = cls_map[ckey] = len(reps)
+                        reps.append(i)
+                    sl_class_np[i] = c
+                if reps is not None:
+                    shortlist_k = k
+                    sl_reps_np = np.zeros(
+                        (SHORTLIST_CLASS_PAD,), dtype=np.int32)
+                    sl_reps_np[: len(reps)] = reps
+
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are
         # PRIORITY-BLOCK-STABLE: pods only move within runs of equal
@@ -1823,6 +2042,9 @@ class TPUBackend:
             "chunk_idx": chunk_idx,
             "dev_perms": dev_perms, "gang_onehot": gang_onehot,
             "gang_required": gang_required,
+            "shortlist_k": shortlist_k, "sl_reps": sl_reps_np,
+            "sl_class": sl_class_np,
+            "scan_width": (shortlist_k + P) if shortlist_k else ct.n_real,
         }
 
     def _dispatch_chunk(self, prep: dict, ctx: "_AssignCtx") -> dict:
@@ -1865,6 +2087,16 @@ class TPUBackend:
                        self._put(prep["sp_contrib"]))
         else:
             sp_args = self._spread_dummies(ct.n_pad, batch.req_q.shape[0])
+        if prep["shortlist_k"]:
+            sl_args = (self._put(prep["sl_reps"]),
+                       self._put(prep["sl_class"]))
+        else:
+            P = batch.req_q.shape[0]
+            sl_args = self._dev_zero_sl.get(P)
+            if sl_args is None:
+                sl_args = self._dev_zero_sl[P] = (
+                    self._put(np.zeros((SHORTLIST_CLASS_PAD,), np.int32)),
+                    self._put(np.zeros((P,), np.int32)))
         assign_d, used_pack2, fit0_d, taint_ok_d, dom_counts2 = \
             _mask_solve_update(
                 self._dev_static["alloc_q"], self._dev_used,
@@ -1876,7 +2108,8 @@ class TPUBackend:
                 p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
                 *sp_args,
                 prep["dev_perms"], *self._gang_args(prep, batch),
-                p["strategy"], use_spread,
+                *sl_args,
+                p["strategy"], use_spread, prep["shortlist_k"],
             )
         self._dev_used = used_pack2
         if use_spread:
@@ -1896,6 +2129,21 @@ class TPUBackend:
                         ctx: "_AssignCtx") -> None:
         pods, batch = run["pods"], run["batch"]
         assign = assign_np[: batch.p_real]
+
+        # Solve-side observability: the fused program appends the chunk's
+        # shortlist fallback count to the assign vector (one fetch). The
+        # tuner's hit-rate feedback widens K when fallbacks climb. A
+        # poisoned multistart chunk reports the PADDED width — clamp to
+        # real pods so rates never exceed 100%.
+        nfall = min(int(assign_np[-1]), batch.p_real)
+        if run.get("shortlist_k"):
+            self._tuner.observe_solve(batch.p_real, nfall)
+        if self.metrics is not None:
+            self.metrics.solver_scan_width.set(run["scan_width"])
+            if run.get("shortlist_k"):
+                self.metrics.solver_shortlist_pods.inc(batch.p_real)
+                if nfall:
+                    self.metrics.solver_shortlist_fallbacks.inc(nfall)
 
         # Host verify + working-state accumulation (hard part #1). The
         # verify context is shared across chunks, so later chunks are

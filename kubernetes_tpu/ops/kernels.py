@@ -118,6 +118,33 @@ def balanced_allocation_score(alloc_q, used_nz_q, req_nz_q, col_mask):
     return jnp.where(cnt >= 2, score, 0.0)
 
 
+# --- shortlist prefilter: chunk-start live scores ---------------------------
+
+def chunk_start_scores(alloc_q, used_nz_q, req_nz_q, static_scores,
+                       fit_col_w, bal_col_mask, shape_u, shape_s,
+                       w_fit, w_bal, strategy: str):
+    """The full live score (static + weighted fit + weighted balanced) at the
+    CHUNK-START used state — the shortlist prefilter's per-node value.
+
+    Two roles in the pruned solve (ops/solver shortlist scans):
+
+    - ordering: per pod, the top-K nodes by this value are the candidate
+      columns the narrow scan re-scores live; the (K+1)-th value is the
+      exactness threshold.
+    - identity: within a chunk, a node's live score changes ONLY when the
+      node is debited by an assignment, so for UNTOUCHED nodes this value
+      IS the in-scan score, bit-for-bit — the scans gather it back instead
+      of recomputing, keeping the threshold comparison float-consistent.
+
+    alloc_q/used_nz_q: (N,R); req_nz_q: (S,R); static_scores: (S,N)
+    → (S,N) float32.
+    """
+    sc = static_scores + w_fit * fit_score(
+        alloc_q, used_nz_q, req_nz_q, fit_col_w, strategy, shape_u, shape_s)
+    return sc + w_bal * balanced_allocation_score(
+        alloc_q, used_nz_q, req_nz_q, bal_col_mask)
+
+
 # --- TaintToleration: Score --------------------------------------------------
 
 def taint_toleration_score(node_prefer_taints, untol_prefer, feasible):

@@ -251,6 +251,18 @@ def multistart_greedy_assign(req_q, req_nz_q, free_q, free_pods, used_nz_q,
     perms: (K, P) int32 permutations of the pod axis.
     Returns (P,) int32 chosen assignment (-1 = unassigned).
     """
+    return _multistart_body(
+        req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+        static_scores, fit_col_w, bal_col_mask, shape_u, shape_s, w_fit,
+        w_bal, strategy, perms, gang_onehot, gang_required)
+
+
+def _multistart_body(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+                     mask, static_scores, fit_col_w, bal_col_mask, shape_u,
+                     shape_s, w_fit, w_bal, strategy, perms, gang_onehot,
+                     gang_required):
+    """Traceable multistart core — also the shortlist path's whole-chunk
+    fallback branch (see multistart_greedy_assign_shortlist)."""
     P = req_q.shape[0]
     arange_p = jnp.arange(P, dtype=jnp.int32)
 
@@ -263,6 +275,11 @@ def multistart_greedy_assign(req_q, req_nz_q, free_q, free_pods, used_nz_q,
         return a[inv]
 
     assigns = jax.vmap(one)(perms)                         # (K, P)
+    return _select_best(assigns, req_q, gang_onehot, gang_required)
+
+
+def _select_best(assigns, req_q, gang_onehot, gang_required):
+    """Gang-filter K candidate assignments and keep the best order."""
     eff = jax.vmap(
         lambda a: gang_filter(a, gang_onehot, gang_required))(assigns)
     placed = eff >= 0
@@ -286,6 +303,347 @@ def gang_filter(assign, gang_onehot, gang_required):
     pod_ok = (gang_onehot @ gang_ok) > 0
     keep = (assign >= 0) & (pod_ok | ~pod_in_gang)
     return jnp.where(keep, assign, -1)
+
+
+# ---------------------------------------------------------------------------
+# Shortlist-pruned solve: per-pod top-K candidate columns with an exactness
+# fallback — the O(P·K + fallbacks·N) form of the sequential-equivalent scan
+# for large N (the 50k-node preset is bound by the N-wide inner reduce).
+# ---------------------------------------------------------------------------
+
+def shortlist_prefilter(feas0, sc0, k: int):
+    """Per-row top-K candidate columns + the exactness threshold.
+
+    feas0: (S,N) bool chunk-start feasibility (static mask ∧ capacity fit;
+        within a chunk capacity only DECREASES, so a chunk-start-infeasible
+        node can never become the winner — spread gating is deliberately
+        NOT folded in, it is non-monotone and re-checked in-scan).
+    sc0:   (S,N) float32 chunk-start live scores (kernels.chunk_start_scores).
+
+    Returns (cand (S,K) int32, thresh (S,)): the K best columns per row and
+    the (K+1)-th value — the max score any node OUTSIDE the shortlist can
+    ever reach during the chunk's scan, because a node's live score moves
+    only when the node is debited, debits are tracked (touched nodes join
+    the scan's candidate set), and untouched nodes keep sc0 exactly.
+    -inf threshold ⇔ the shortlist already holds every feasible node.
+
+    lax.top_k breaks ties toward the LOWER index — load-bearing for the
+    scans' tie rule: every node outside the shortlist whose sc0 equals the
+    threshold has a HIGHER index than every in-list node at that value, so
+    an untouched in-list winner at exactly the threshold still wins the
+    full scan's lowest-index tie-break.
+    """
+    vals, cand = lax.top_k(jnp.where(feas0, sc0, NEG_INF), k + 1)
+    return cand[:, :k].astype(jnp.int32), vals[:, k]
+
+
+def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
+                    alloc_q, mask, static_scores, fit_col_w, bal_col_mask,
+                    shape_u, shape_s, w_fit, w_bal, strategy: str,
+                    sc0, sl_class, sl_cand, sl_thresh, has_node,
+                    inline_fallback: bool):
+    """The narrow sequential-equivalent scan: per pod, re-score only the
+    pod's K shortlist columns plus every node already debited this chunk,
+    and prove the winner exact against the prefilter threshold.
+
+    Exactness argument, per step: nodes fall in three classes —
+    (a) shortlist candidates and (b) nodes touched (debited) earlier in
+    this chunk are both IN the candidate set and re-scored live; (c) an
+    untouched node outside the shortlist still scores exactly its sc0
+    ≤ thresh. So when the candidate-set winner's score beats `thresh`
+    strictly — or ties it while itself untouched (see shortlist_prefilter
+    on why the index tie-break then also goes the winner's way) — it is
+    the full N-wide argmax. Otherwise the step falls back to the full row:
+    inline via lax.cond when `inline_fallback` (single-order scans — the
+    cond executes one branch), or by poisoning the whole scan when the
+    caller runs under vmap (lax.cond would become a both-branches select
+    there and the pruning would buy nothing).
+
+    Untouched candidates gather their score from sc0 rather than
+    recomputing it, so the `== thresh` comparison never straddles two
+    float evaluations of the same quantity.
+
+    sc0: (S,N) class-level chunk-start scores; sl_class: (P,) row index
+    per pod (pods of one template share a class — and a shortlist);
+    sl_cand: (P,K); sl_thresh: (P,); has_node: (P,) bool — pods whose
+    static mask is empty (padding, unknown resources) trivially resolve
+    to -1 with no fallback.
+
+    `rows` (P,) maps each step to its pod's row in the UNPERMUTED
+    (P,N) mask/static_scores planes, which stay closed-over: the trusted
+    path reads them through (row, ci) element gathers, never a row slice
+    — an (N,)-wide xs row per step would put O(N) memory traffic back
+    into the scan (and a permuted multistart copy would materialize the
+    planes once per order). Only the fallback branch slices a full row,
+    and only when taken.
+
+    Returns (assign (P,), fallbacks int32, poisoned bool). With
+    inline_fallback the assignment is exact and poisoned is always False;
+    without it the assignment is only valid when poisoned is False.
+    """
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = free_q.shape[0]
+    p = req_q.shape[0]
+
+    def step(carry, inp):
+        free_q, free_pods, used_nz, touched, tidx, kstep, nfall, pois = carry
+        req, req_nz, row, cand, t, cls, hn = inp
+        cset = jnp.concatenate([cand, tidx])               # (K+P,)
+        valid = cset < n
+        ci = jnp.where(valid, cset, 0)
+        live = static_scores[row, ci]
+        live = live + w_fit * kernels.fit_score(
+            alloc_q[ci], used_nz[ci], req_nz[None, :], fit_col_w, strategy,
+            shape_u, shape_s)[0]
+        live = live + w_bal * kernels.balanced_allocation_score(
+            alloc_q[ci], used_nz[ci], req_nz[None, :], bal_col_mask)[0]
+        live = jnp.where(touched[ci], live, sc0[cls, ci])
+        fits = mask[row, ci] & valid \
+            & jnp.all(req[None, :] <= free_q[ci], axis=1) \
+            & (free_pods[ci] >= 1)
+        masked = jnp.where(fits, live, NEG_INF)
+        best = jnp.max(masked)
+        any_fit = best > NEG_INF
+        widx = jnp.min(jnp.where(masked == best, ci, n)).astype(jnp.int32)
+        w_touched = touched[jnp.minimum(widx, n - 1)]
+        trusted = jnp.where(
+            any_fit,
+            (best > t) | ((best == t) & jnp.logical_not(w_touched)),
+            t == NEG_INF) | jnp.logical_not(hn)
+        sl_idx = jnp.where(any_fit, widx, jnp.int32(-1))
+        if inline_fallback:
+            def full_row(_):
+                fits_n = mask[row] & jnp.all(req[None, :] <= free_q, axis=1) \
+                    & (free_pods >= 1)
+                sc = static_scores[row]
+                sc = sc + w_fit * kernels.fit_score(
+                    alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
+                    shape_u, shape_s)[0]
+                sc = sc + w_bal * kernels.balanced_allocation_score(
+                    alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
+                mk = jnp.where(fits_n, sc, NEG_INF)
+                i2 = jnp.argmax(mk).astype(jnp.int32)
+                return jnp.where(jnp.any(fits_n), i2, jnp.int32(-1))
+
+            idx = lax.cond(trusted, lambda _: sl_idx, full_row, None)
+        else:
+            idx = sl_idx
+            pois = pois | jnp.logical_not(trusted)
+        nfall = nfall + jnp.logical_not(trusted).astype(jnp.int32)
+        # Scatter updates (O(R), not O(N·R) — the whole point is that no
+        # per-step work scales with N on the trusted path).
+        hit = idx >= 0
+        safe = jnp.clip(idx, 0, n - 1)
+        free_q = free_q.at[safe].add(
+            jnp.where(hit, -req, 0).astype(free_q.dtype))
+        free_pods = free_pods.at[safe].add(
+            jnp.where(hit, -1, 0).astype(free_pods.dtype))
+        used_nz = used_nz.at[safe].add(
+            jnp.where(hit, req_nz, 0).astype(used_nz.dtype))
+        touched = touched.at[safe].set(touched[safe] | hit)
+        tidx = tidx.at[kstep].set(jnp.where(hit, idx, n))
+        return (free_q, free_pods, used_nz, touched, tidx, kstep + 1,
+                nfall, pois), idx
+
+    carry0 = (free_q, free_pods, used_nz_q,
+              jnp.zeros((n,), jnp.bool_),
+              jnp.full((p,), n, jnp.int32),
+              jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+    (_, _, _, _, _, _, nfall, pois), assign = lax.scan(
+        step, carry0,
+        (req_q, req_nz_q, rows, sl_cand, sl_thresh, sl_class, has_node))
+    return assign, nfall, pois
+
+
+@partial(jax.jit, static_argnames=("strategy",))
+def greedy_assign_rescoring_shortlist(req_q, req_nz_q, free_q, free_pods,
+                                      used_nz_q, alloc_q, mask,
+                                      static_scores, fit_col_w, bal_col_mask,
+                                      shape_u, shape_s, w_fit, w_bal,
+                                      strategy: str,
+                                      sc0, sl_class, sl_cand, sl_thresh,
+                                      has_node):
+    """greedy_assign_rescoring, shortlist-pruned: bit-identical assignments
+    at O(P·(K+P)) with per-step inline fallback to the full N-wide row
+    (the lax.cond executes one branch — fallbacks cost O(N) only when
+    taken). Returns (assign (P,), fallbacks int32)."""
+    rows = jnp.arange(req_q.shape[0], dtype=jnp.int32)
+    assign, nfall, _ = _shortlist_scan(
+        req_q, req_nz_q, rows, free_q, free_pods, used_nz_q, alloc_q, mask,
+        static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+        w_fit, w_bal, strategy, sc0, sl_class, sl_cand, sl_thresh,
+        has_node, inline_fallback=True)
+    return assign, nfall
+
+
+@partial(jax.jit, static_argnames=("strategy",))
+def multistart_greedy_assign_shortlist(req_q, req_nz_q, free_q, free_pods,
+                                       used_nz_q, alloc_q, mask,
+                                       static_scores, fit_col_w,
+                                       bal_col_mask, shape_u, shape_s,
+                                       w_fit, w_bal, strategy: str, perms,
+                                       gang_onehot, gang_required,
+                                       sc0, sl_class, sl_cand, sl_thresh,
+                                       has_node):
+    """multistart_greedy_assign, shortlist-pruned.
+
+    The K permuted scans run vmapped, so a per-step lax.cond would lower
+    to a both-branches select and re-pay the N-wide row every step — the
+    narrow scans instead mark any step whose bound check fails as
+    POISONED, and one outer lax.cond (not vmapped — a real branch) reruns
+    the whole chunk through the full multistart when any order was
+    poisoned. Shortlist/threshold are chunk-start state, so they are
+    permutation-independent; only per-pod rows reorder.
+
+    Returns (assign (P,), fallback_pods int32) — fallback accounting is
+    whole-chunk here (P on a poisoned chunk, 0 otherwise)."""
+    P = req_q.shape[0]
+    arange_p = jnp.arange(P, dtype=jnp.int32)
+
+    def one(perm):
+        # Only the small per-pod vectors permute; the (P,N) planes stay
+        # unpermuted and the scan addresses them through `rows=perm` —
+        # permuting them here would materialize one copy per order.
+        a, _, pois = _shortlist_scan(
+            req_q[perm], req_nz_q[perm], perm, free_q, free_pods,
+            used_nz_q, alloc_q, mask, static_scores, fit_col_w,
+            bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy,
+            sc0, sl_class[perm], sl_cand[perm], sl_thresh[perm],
+            has_node[perm], inline_fallback=False)
+        inv = jnp.zeros_like(perm).at[perm].set(arange_p)
+        return a[inv], pois
+
+    assigns, pois = jax.vmap(one)(perms)
+    any_pois = jnp.any(pois)
+
+    def full(_):
+        return _multistart_body(
+            req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+            static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+            w_fit, w_bal, strategy, perms, gang_onehot, gang_required)
+
+    def take(_):
+        return _select_best(assigns, req_q, gang_onehot, gang_required)
+
+    assign = lax.cond(any_pois, full, take, None)
+    return assign, jnp.where(any_pois, jnp.int32(P), jnp.int32(0))
+
+
+@partial(jax.jit, static_argnames=("strategy",))
+def greedy_assign_rescoring_spread_shortlist(
+        req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+        static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+        w_fit, w_bal, strategy: str,
+        dom_onehot, cid_onehot, dom_counts, max_skew, min_ok, has_key_nc,
+        applies, contributes,
+        sc0, sl_class, sl_cand, sl_thresh, has_node):
+    """greedy_assign_rescoring_spread, shortlist-pruned (identity order,
+    inline per-step fallback like the non-spread scan).
+
+    Spread gating is non-monotone (a domain can open as the global min
+    rises), so it deliberately plays no part in the prefilter: shortlist
+    and threshold are capacity/mask/score-only — an outside node's SCORE
+    is still bounded by the threshold whatever its gating does, and the
+    in-scan candidate set applies the exact per-step gate. Conservative
+    only: a pod whose allowed domains all sit outside its score head
+    falls back to the full row.
+
+    Returns (assign (P,), dom_counts', fallbacks int32)."""
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = free_q.shape[0]
+    p = req_q.shape[0]
+    big = jnp.float32(1e30)
+    in_dom_nc = (dom_onehot @ cid_onehot) > 0                          # (N,C)
+    gate_nc = has_key_nc > 0
+
+    rows_p = jnp.arange(p, dtype=jnp.int32)
+
+    def step(carry, inp):
+        (free_q, free_pods, used_nz, dcounts, touched, tidx, kstep,
+         nfall) = carry
+        req, req_nz, row, app, contrib, cand, t, cls, hn = inp
+        min_c = jnp.min(
+            jnp.where(cid_onehot > 0, dcounts[:, None], big), axis=0)  # (C,)
+        min_c = min_c * min_ok
+        self_d = cid_onehot @ contrib                                  # (D,)
+        allowed_d = (dcounts + self_d - cid_onehot @ min_c) \
+            <= (cid_onehot @ max_skew)                                 # (D,)
+        allowed_dc = allowed_d[:, None] * cid_onehot                   # (D,C)
+
+        cset = jnp.concatenate([cand, tidx])
+        valid = cset < n
+        ci = jnp.where(valid, cset, 0)
+        in_allowed_c = (dom_onehot[ci] @ allowed_dc) > 0               # (C',C)
+        node_ok_c = gate_nc[ci] & (
+            in_allowed_c | jnp.logical_not(in_dom_nc[ci]))
+        spread_ok_c = jnp.all(node_ok_c | (app[None, :] == 0), axis=1)
+        live = static_scores[row, ci]
+        live = live + w_fit * kernels.fit_score(
+            alloc_q[ci], used_nz[ci], req_nz[None, :], fit_col_w, strategy,
+            shape_u, shape_s)[0]
+        live = live + w_bal * kernels.balanced_allocation_score(
+            alloc_q[ci], used_nz[ci], req_nz[None, :], bal_col_mask)[0]
+        live = jnp.where(touched[ci], live, sc0[cls, ci])
+        fits = mask[row, ci] & valid & spread_ok_c \
+            & jnp.all(req[None, :] <= free_q[ci], axis=1) \
+            & (free_pods[ci] >= 1)
+        masked = jnp.where(fits, live, NEG_INF)
+        best = jnp.max(masked)
+        any_fit = best > NEG_INF
+        widx = jnp.min(jnp.where(masked == best, ci, n)).astype(jnp.int32)
+        w_touched = touched[jnp.minimum(widx, n - 1)]
+        trusted = jnp.where(
+            any_fit,
+            (best > t) | ((best == t) & jnp.logical_not(w_touched)),
+            t == NEG_INF) | jnp.logical_not(hn)
+        sl_idx = jnp.where(any_fit, widx, jnp.int32(-1))
+
+        def full_row(_):
+            in_allowed = (dom_onehot @ allowed_dc) > 0
+            node_c_ok = gate_nc & (in_allowed | jnp.logical_not(in_dom_nc))
+            spread_ok = jnp.all(node_c_ok | (app[None, :] == 0), axis=1)
+            fits_n = mask[row] & jnp.all(req[None, :] <= free_q, axis=1) \
+                & (free_pods >= 1) & spread_ok
+            sc = static_scores[row]
+            sc = sc + w_fit * kernels.fit_score(
+                alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
+                shape_u, shape_s)[0]
+            sc = sc + w_bal * kernels.balanced_allocation_score(
+                alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
+            mk = jnp.where(fits_n, sc, NEG_INF)
+            i2 = jnp.argmax(mk).astype(jnp.int32)
+            return jnp.where(jnp.any(fits_n), i2, jnp.int32(-1))
+
+        idx = lax.cond(trusted, lambda _: sl_idx, full_row, None)
+        nfall = nfall + jnp.logical_not(trusted).astype(jnp.int32)
+        hit = idx >= 0
+        safe = jnp.clip(idx, 0, n - 1)
+        free_q = free_q.at[safe].add(
+            jnp.where(hit, -req, 0).astype(free_q.dtype))
+        free_pods = free_pods.at[safe].add(
+            jnp.where(hit, -1, 0).astype(free_pods.dtype))
+        used_nz = used_nz.at[safe].add(
+            jnp.where(hit, req_nz, 0).astype(used_nz.dtype))
+        # Same accounting as the full spread scan's `hit @ dom_onehot`,
+        # via one row gather instead of an O(N·D) reduce.
+        dcounts = dcounts + jnp.where(
+            hit, dom_onehot[safe] * (cid_onehot @ contrib), 0.0)
+        touched = touched.at[safe].set(touched[safe] | hit)
+        tidx = tidx.at[kstep].set(jnp.where(hit, idx, n))
+        return (free_q, free_pods, used_nz, dcounts, touched, tidx,
+                kstep + 1, nfall), idx
+
+    carry0 = (free_q, free_pods, used_nz_q, dom_counts,
+              jnp.zeros((n,), jnp.bool_),
+              jnp.full((p,), n, jnp.int32),
+              jnp.int32(0), jnp.int32(0))
+    (_, _, _, dom_counts2, _, _, _, nfall), assign = lax.scan(
+        step, carry0,
+        (req_q, req_nz_q, rows_p, applies, contributes,
+         sl_cand, sl_thresh, sl_class, has_node))
+    return assign, dom_counts2, nfall
 
 
 #: int32 "no victim" priority padding — mirrors _WaveState.INF (int64 there;

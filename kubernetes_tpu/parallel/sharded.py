@@ -42,6 +42,14 @@ try:  # jax>=0.8 top-level; fall back for older versions
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+# The replication-check kwarg was renamed across jax versions
+# (check_rep → check_vma); pass whichever this jax understands.
+import inspect as _inspect
+
+_params = _inspect.signature(shard_map).parameters
+_SHARD_MAP_KW = {"check_vma": False} if "check_vma" in _params else (
+    {"check_rep": False} if "check_rep" in _params else {})
+
 _INT_MAX = jnp.int32(2**31 - 1)
 
 _PHASE_CACHE: dict = {}
@@ -110,17 +118,31 @@ def _masks_scores_phase(mesh: Mesh, strategy: str):
 def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                           used_nz_q, alloc_q, mask, static_scores,
                           fit_col_w, bal_col_mask, shape_u, shape_s,
-                          w_fit, w_bal, strategy: str):
+                          w_fit, w_bal, strategy: str,
+                          shortlist_k: int = 0):
     """Sequential-equivalent greedy with live re-scoring, node axis sharded.
 
     Per scan step: shard-local candidate (max score, min index among ties) →
     global winner via `pmax` then `pmin` over the nodes axis → winning shard
     debits capacity. Semantics match ops/solver.greedy_assign_rescoring
-    exactly (ties → lowest global node index)."""
+    exactly (ties → lowest global node index).
+
+    shortlist_k > 0 prunes SHARD-LOCALLY before the cross-shard argmax:
+    each shard prefilters its own top-K columns per pod (by shard-local
+    chunk-start score) and re-scores only those plus its locally-debited
+    nodes per step, with the same per-step exactness bound check and full
+    local-row fallback as ops/solver's shortlist scans — so the local
+    candidate entering the `pmax` is always the true shard maximum and the
+    global winner is bit-identical. The per-step ICI reduction was already
+    O(1) scalars; what shrinks is each shard's local reduce, N/devices →
+    K/devices + touched. A shard narrower than K+1 columns keeps the full
+    local scan (nothing to prune)."""
     n_shards = mesh.shape[NODES_AXIS]
     n_total = free_q.shape[0]
     assert n_total % n_shards == 0, (n_total, n_shards)
-    run = _solver_fn(mesh, strategy, n_total // n_shards)
+    local_n = n_total // n_shards
+    k = min(shortlist_k, local_n - 1) if shortlist_k else 0
+    run = _solver_fn(mesh, strategy, local_n, shortlist_k=max(k, 0))
     return run(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
                mask, static_scores, fit_col_w, bal_col_mask,
                jnp.asarray(shape_u), jnp.asarray(shape_s),
@@ -128,13 +150,14 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
 
 
 def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
-               axes: tuple[str, ...] = (NODES_AXIS,)):
+               axes: tuple[str, ...] = (NODES_AXIS,),
+               shortlist_k: int = 0):
     """One solver body for every mesh shape: the node dimension shards over
     `axes` (flattened, first axis major). Reductions run innermost-axis
     first, so a (slice, nodes) pair reduces slice-locally over ICI before
     ONE scalar per slice crosses DCN — the hierarchical argmax of SURVEY
     §5.7 falls out of the axis order."""
-    key = (mesh, strategy, local_n, axes)
+    key = (mesh, strategy, local_n, axes, shortlist_k)
     fn = _SOLVER_CACHE.get(key)
     if fn is not None:
         return fn
@@ -153,20 +176,24 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
     @partial(shard_map, mesh=mesh,
              in_specs=(rep, rep, spec_nr, spec_n, spec_nr, spec_nr,
                        spec_pn, spec_pn, rep, rep, rep, rep, rep, rep),
-             out_specs=rep, check_vma=False)
+             out_specs=rep, **_SHARD_MAP_KW)
     def run(req_q, req_nz_q, free_q, free_pods, used_nz, alloc_q,
             mask, static_sc, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal):
         shard = jnp.int32(0)
         for a in axes:
-            shard = shard * lax.axis_size(a) + lax.axis_index(a)
+            # mesh.shape is static — lax.axis_size only exists on newer jax.
+            shard = shard * mesh.shape[a] + lax.axis_index(a)
         base = (shard * local_n).astype(jnp.int32)
         iota = jnp.arange(local_n, dtype=jnp.int32)
+        p_pods = req_q.shape[0]
 
-        def step(carry, inp):
-            free_q, free_pods, used_nz = carry
-            req, req_nz, m, sc_static = inp
-            fits = m & jnp.all(req[None, :] <= free_q, axis=1) & (free_pods >= 1)
+        def local_full(req, req_nz, m, sc_static, free_q, free_pods,
+                       used_nz):
+            """Exact local (best score, local argmin-index) over the whole
+            shard — the unpruned per-step body and the fallback branch."""
+            fits = m & jnp.all(req[None, :] <= free_q, axis=1) \
+                & (free_pods >= 1)
             sc = sc_static
             sc = sc + w_fit * kernels.fit_score(
                 alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
@@ -174,20 +201,99 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
             sc = sc + w_bal * kernels.balanced_allocation_score(
                 alloc_q, used_nz, req_nz[None, :], bal_col_mask)[0]
             masked = jnp.where(fits, sc, -jnp.inf)
-            gbest = _reduce(jnp.max(masked), lax.pmax)
+            lbest = jnp.max(masked)
+            lidx = jnp.min(jnp.where(masked == lbest, iota, local_n))
+            return lbest, lidx.astype(jnp.int32)
+
+        if shortlist_k:
+            # Shard-local prefilter: chunk-start scores over MY columns,
+            # per-pod top-K + the (K+1)-th value as the local threshold.
+            fits0 = jnp.all(req_q[:, None, :] <= free_q[None, :, :],
+                            axis=-1) & (free_pods >= 1)[None, :]
+            sc0 = kernels.chunk_start_scores(
+                alloc_q, used_nz, req_nz_q, static_sc, fit_col_w,
+                bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy)
+            vals, cand0 = lax.top_k(
+                jnp.where(mask & fits0, sc0, -jnp.inf), shortlist_k + 1)
+            sl_cand = cand0[:, :shortlist_k].astype(jnp.int32)
+            sl_t = vals[:, shortlist_k]
+
+        def step(carry, inp):
+            if shortlist_k:
+                free_q, free_pods, used_nz, touched, tidx, kstep = carry
+                req, req_nz, cand, t = inp
+                cset = jnp.concatenate([cand, tidx])
+                valid = cset < local_n
+                ci = jnp.where(valid, cset, 0)
+                # (row, ci) element gathers off the closed-over local
+                # planes — an (local_n,)-wide xs row per step would put
+                # O(local_n) traffic back into the pruned scan.
+                live = static_sc[kstep, ci]
+                live = live + w_fit * kernels.fit_score(
+                    alloc_q[ci], used_nz[ci], req_nz[None, :], fit_col_w,
+                    strategy, shape_u, shape_s)[0]
+                live = live + w_bal * kernels.balanced_allocation_score(
+                    alloc_q[ci], used_nz[ci], req_nz[None, :],
+                    bal_col_mask)[0]
+                live = jnp.where(touched[ci], live, sc0[kstep, ci])
+                fits = mask[kstep, ci] & valid \
+                    & jnp.all(req[None, :] <= free_q[ci], axis=1) \
+                    & (free_pods[ci] >= 1)
+                masked = jnp.where(fits, live, -jnp.inf)
+                sbest = jnp.max(masked)
+                any_l = sbest > -jnp.inf
+                sidx = jnp.min(jnp.where(masked == sbest, ci, local_n)
+                               ).astype(jnp.int32)
+                w_t = touched[jnp.minimum(sidx, local_n - 1)]
+                trusted = jnp.where(
+                    any_l,
+                    (sbest > t) | ((sbest == t) & jnp.logical_not(w_t)),
+                    t == -jnp.inf)
+                lbest, lidx = lax.cond(
+                    trusted,
+                    lambda _: (sbest,
+                               jnp.where(any_l, sidx, jnp.int32(local_n))),
+                    lambda _: local_full(req, req_nz, mask[kstep],
+                                         static_sc[kstep], free_q,
+                                         free_pods, used_nz),
+                    None)
+            else:
+                free_q, free_pods, used_nz = carry
+                req, req_nz, m, sc_static = inp
+                lbest, lidx = local_full(req, req_nz, m, sc_static,
+                                         free_q, free_pods, used_nz)
+            gbest = _reduce(lbest, lax.pmax)
             # Tie-break: lowest global index among shards holding gbest.
-            cand = jnp.where(masked >= gbest, iota + base, _INT_MAX)
-            gidx = _reduce(jnp.min(cand), lax.pmin)
+            gcand = jnp.where((lidx < local_n) & (lbest >= gbest),
+                              lidx + base, _INT_MAX)
+            gidx = _reduce(gcand, lax.pmin)
             chosen = jnp.where(jnp.isfinite(gbest), gidx, jnp.int32(-1))
-            hit = (iota + base) == chosen
-            free_q = free_q - jnp.where(hit[:, None], req[None, :], 0)
-            free_pods = free_pods - hit.astype(jnp.int32)
-            used_nz = used_nz + jnp.where(hit[:, None], req_nz[None, :], 0)
+            li = chosen - base
+            inb = (li >= 0) & (li < local_n)
+            safe = jnp.clip(li, 0, local_n - 1)
+            free_q = free_q.at[safe].add(
+                jnp.where(inb, -req, 0).astype(free_q.dtype))
+            free_pods = free_pods.at[safe].add(
+                jnp.where(inb, -1, 0).astype(free_pods.dtype))
+            used_nz = used_nz.at[safe].add(
+                jnp.where(inb, req_nz, 0).astype(used_nz.dtype))
+            if shortlist_k:
+                touched = touched.at[safe].set(touched[safe] | inb)
+                tidx = tidx.at[kstep].set(jnp.where(inb, li, local_n))
+                return (free_q, free_pods, used_nz, touched, tidx,
+                        kstep + 1), chosen
             return (free_q, free_pods, used_nz), chosen
 
-        (_, _, _), assign = lax.scan(
-            step, (free_q, free_pods, used_nz),
-            (req_q, req_nz_q, mask, static_sc))
+        if shortlist_k:
+            carry0 = (free_q, free_pods, used_nz,
+                      jnp.zeros((local_n,), jnp.bool_),
+                      jnp.full((p_pods,), local_n, jnp.int32),
+                      jnp.int32(0))
+            xs = (req_q, req_nz_q, sl_cand, sl_t)
+        else:
+            carry0 = (free_q, free_pods, used_nz)
+            xs = (req_q, req_nz_q, mask, static_sc)
+        _, assign = lax.scan(step, carry0, xs)
         return assign
 
     _SOLVER_CACHE[key] = run
@@ -202,7 +308,7 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
                                      free_pods, used_nz_q, alloc_q, mask,
                                      static_scores, fit_col_w, bal_col_mask,
                                      shape_u, shape_s, w_fit, w_bal,
-                                     strategy: str):
+                                     strategy: str, shortlist_k: int = 0):
     """Sequential-equivalent greedy over a (slice × nodes) mesh: the same
     solver body as `sharded_greedy_assign`, with the node dimension sharded
     over BOTH axes and the per-step argmax reduced hierarchically —
@@ -214,8 +320,10 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
     n_total = free_q.shape[0]
     shards = s_shards * n_shards
     assert n_total % shards == 0, (n_total, shards)
-    run = _solver_fn(mesh, strategy, n_total // shards,
-                     axes=(SLICE_AXIS, NODES_AXIS))
+    local_n = n_total // shards
+    k = min(shortlist_k, local_n - 1) if shortlist_k else 0
+    run = _solver_fn(mesh, strategy, local_n,
+                     axes=(SLICE_AXIS, NODES_AXIS), shortlist_k=max(k, 0))
     return run(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
                mask, static_scores, fit_col_w, bal_col_mask,
                jnp.asarray(shape_u), jnp.asarray(shape_s),

@@ -101,6 +101,17 @@ class WorkloadResult:
         #: the detail JSON, not stderr noise.
         self.policy_evaluations_total = 0
         self.audit_events_total = 0
+        #: Solve-side accounting over the measured phase (the r8 50k
+        #: profile's 98%-idle blind spot made data): chunk count and
+        #: total device-solve wall (the fused solve as the consumer sees
+        #: it — scheduler_tpu_solve_seconds), the per-step scan width of
+        #: the last chunk (K + P when the shortlist prunes, N when not),
+        #: and the shortlist's exactness-fallback counters.
+        self.solver_solve_chunks = 0
+        self.solver_solve_seconds_total = 0.0
+        self.solver_scan_width = 0
+        self.solver_shortlist_pods_total = 0
+        self.solver_shortlist_fallbacks_total = 0
 
     def as_dict(self) -> dict:
         import math
@@ -131,6 +142,16 @@ class WorkloadResult:
                 self.watch_predicate_checks_total,
             "policy_evaluations_total": self.policy_evaluations_total,
             "audit_events_total": self.audit_events_total,
+            "solver_solve_chunks": self.solver_solve_chunks,
+            "solver_solve_seconds_total": round(
+                self.solver_solve_seconds_total, 3),
+            "solver_scan_width": self.solver_scan_width,
+            "solver_shortlist_fallbacks_total":
+                self.solver_shortlist_fallbacks_total,
+            "solver_shortlist_hit_pct": round(
+                100.0 * (1.0 - self.solver_shortlist_fallbacks_total
+                         / self.solver_shortlist_pods_total), 2)
+            if self.solver_shortlist_pods_total else None,
         }
 
 
@@ -584,13 +605,19 @@ class PerfRunner:
             deg.value(kind="spread_poisoned"),
             wm.events_dispatched.value(),
             wm.predicate_checks.value(),
-            *self._policy_totals())
+            *self._policy_totals(),
+            metrics.solve_duration.count(),
+            metrics.solve_duration.sum(),
+            metrics.solver_shortlist_pods.value(),
+            metrics.solver_shortlist_fallbacks.value())
 
     def _end_measure(self, result: WorkloadResult,
                      metrics: SchedulerMetrics,
                      backing, window: tuple, count: int) -> None:
         (hist_base, t0, fallback_base, poisoned_base,
-         dispatched_base, checks_base, evals_base, audits_base) = window
+         dispatched_base, checks_base, evals_base, audits_base,
+         solve_chunks_base, solve_s_base, sl_pods_base,
+         sl_fall_base) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
         result.measured_seconds = dt
@@ -613,6 +640,15 @@ class PerfRunner:
         evals, audits = self._policy_totals()
         result.policy_evaluations_total = int(evals - evals_base)
         result.audit_events_total = int(audits - audits_base)
+        result.solver_solve_chunks = int(
+            metrics.solve_duration.count() - solve_chunks_base)
+        result.solver_solve_seconds_total = \
+            metrics.solve_duration.sum() - solve_s_base
+        result.solver_scan_width = int(metrics.solver_scan_width.value())
+        result.solver_shortlist_pods_total = int(
+            metrics.solver_shortlist_pods.value() - sl_pods_base)
+        result.solver_shortlist_fallbacks_total = int(
+            metrics.solver_shortlist_fallbacks.value() - sl_fall_base)
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

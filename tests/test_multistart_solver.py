@@ -93,6 +93,20 @@ class TestMultistartPacking:
         assert a1 == a4
 
 
+def gang_scheduler(store, backend):
+    """Scheduler whose profile actually ENABLES Coscheduling (it is
+    registered but deliberately not default-enabled, like the reference's
+    out-of-tree plugin). The original tests built the DEFAULT profile —
+    no gang plugin at all — and only ever passed because the solver's jit
+    compile outlasted their settle window before anything could bind; a
+    warm jit cache (any long suite run) exposed 2-of-3 members binding."""
+    from kubernetes_tpu.scheduler.plugins.registry import DEFAULT_PLUGINS
+    plugins = build_plugins(DEFAULT_PLUGINS + ["Coscheduling"], store=store)
+    fwk = Framework(plugins, DEFAULT_SCORE_WEIGHTS)
+    return Scheduler(store, profiles={"default-scheduler": fwk},
+                     seed=5, backend=backend)
+
+
 class TestGangInSolver:
     def test_partial_gang_dropped_atomically(self):
         """A 3-member gang (minMember=3) that only fits 2 members is
@@ -106,7 +120,7 @@ class TestGangInSolver:
                     "cpu": "2", "memory": "8Gi", "pods": "110"}))
             await store.create("podgroups", make_pod_group("gang", 3))
             backend = TPUBackend(max_batch=8, multistart=2)
-            sched = Scheduler(store, seed=5, backend=backend)
+            sched = gang_scheduler(store, backend)
             factory = InformerFactory(store)
             await sched.setup_informers(factory)
             factory.start()
@@ -136,7 +150,7 @@ class TestGangInSolver:
                     "cpu": "2", "memory": "8Gi", "pods": "110"}))
             await store.create("podgroups", make_pod_group("gang", 3))
             backend = TPUBackend(max_batch=8, multistart=2)
-            sched = Scheduler(store, seed=5, backend=backend)
+            sched = gang_scheduler(store, backend)
             factory = InformerFactory(store)
             await sched.setup_informers(factory)
             factory.start()
