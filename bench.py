@@ -88,6 +88,15 @@ def main(argv=None) -> int:
                     help="write a jax.profiler device trace of the "
                          "MEASURED phase to this directory (tpu backend "
                          "only)")
+    ap.add_argument("--trace", default="", metavar="OUT.json",
+                    help="enable the in-process tracer and write the "
+                         "run's span tree as Chrome trace-event JSON "
+                         "(open in https://ui.perfetto.dev or "
+                         "chrome://tracing). Spans cover apiserver "
+                         "requests, admission, queue wait, framework "
+                         "extension points, device-solve chunks and "
+                         "binds; KTPU_TRACE_THRESHOLD_MS additionally "
+                         "logs slow span trees")
     ap.add_argument("--feature-gates", default="",
                     help='e.g. "TPUScorer=true" — the north-star seam: the '
                          "batched device backend hangs off this gate "
@@ -98,6 +107,12 @@ def main(argv=None) -> int:
         # Must land before the backend module reads it at import.
         import os
         os.environ["KTPU_SHORTLIST_K"] = str(args.shortlist_k)
+
+    tracer = None
+    if args.trace:
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        tracer = DEFAULT_TRACER
+        tracer.enabled = True
 
     from kubernetes_tpu.perf.scheduler_perf import PerfRunner
     from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATES
@@ -156,6 +171,12 @@ def main(argv=None) -> int:
                         audit_rules=[{"level": args.audit_level}]
                         if args.audit_level else None)
     res = asyncio.run(runner.run(template, params, timeout=1800.0))
+
+    if tracer is not None:
+        with open(args.trace, "w") as f:
+            f.write(tracer.to_perfetto())
+        print(f"trace: {args.trace} ({len(tracer.spans)} spans; open in "
+              "https://ui.perfetto.dev)", file=sys.stderr)
 
     detail = res.as_dict()
     print(json.dumps({"detail": detail, "preset": args.preset,

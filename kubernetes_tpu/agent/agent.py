@@ -321,6 +321,21 @@ class NodeAgent:
         return True
 
     async def _mark_running(self, key: str, pod: dict) -> None:
+        from kubernetes_tpu.utils.tracing import (
+            DEFAULT_TRACER,
+            traceparent_of,
+        )
+        if DEFAULT_TRACER.enabled:
+            # The kubelet-side Running transition joins the pod's create
+            # trace via the stamped traceparent — the last hop of the
+            # create → schedule → bind → run journey.
+            with DEFAULT_TRACER.span("agent.mark_running", pod=key,
+                                     node=self.node_name,
+                                     traceparent=traceparent_of(pod)):
+                return await self._mark_running_inner(key, pod)
+        return await self._mark_running_inner(key, pod)
+
+    async def _mark_running_inner(self, key: str, pod: dict) -> None:
         complete_after = [None]
 
         def mutate(obj):

@@ -120,6 +120,21 @@ class WebhookAdmission:
         Raises Invalid on deny; failurePolicy Fail treats an unreachable
         webhook as deny, Ignore (default here) skips it. `user`/`groups`
         feed the policy expressions' `request.userInfo`."""
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        if DEFAULT_TRACER.enabled:
+            # Admission is the chain stage between the request span and
+            # the store op — its own span so a slow webhook out-call or
+            # policy evaluation is visible in the attempt tree.
+            with DEFAULT_TRACER.span("admission.admit", resource=resource,
+                                     op=operation):
+                return await self._admit_chain(
+                    obj, resource, operation, user=user, groups=groups)
+        return await self._admit_chain(obj, resource, operation,
+                                       user=user, groups=groups)
+
+    async def _admit_chain(self, obj: dict, resource: str, operation: str,
+                           *, user: str | None = None,
+                           groups: list[str] | None = None) -> dict:
         for cfg in self._configs("mutatingwebhookconfigurations"):
             for wh in cfg.get("webhooks") or []:
                 if not _rules_match(wh, resource, operation):

@@ -211,7 +211,8 @@ class RemoteStore:
     async def update(self, resource: str, obj: Mapping, **_kw) -> dict:
         key = namespaced_name(obj)
         async with self._sess().put(
-                self._item_url(resource, key), json=dict(obj)) as resp:
+                self._item_url(resource, key), json=dict(obj),
+                headers=self._trace_headers()) as resp:
             return await self._json(resp)
 
     async def delete(self, resource: str, key: str, *,
@@ -220,7 +221,8 @@ class RemoteStore:
         if uid:
             kwargs["json"] = {"preconditions": {"uid": uid}}
         async with self._sess().delete(
-                self._item_url(resource, key), **kwargs) as resp:
+                self._item_url(resource, key),
+                headers=self._trace_headers(), **kwargs) as resp:
             return await self._json(resp)
 
     async def guaranteed_update(

@@ -42,6 +42,7 @@ from kubernetes_tpu.store.mvcc import (  # noqa: E402
     NotFound,
     StoreError,
 )
+from kubernetes_tpu.utils.tracing import stamp_traceparent  # noqa: E402
 
 logger = logging.getLogger(__name__)
 
@@ -124,8 +125,12 @@ class StoreService:
 
     async def Create(self, request, context):
         try:
-            obj = await self._admit(
-                _unwrap(request.object), request.resource, "create")
+            obj = _unwrap(request.object)
+            if request.resource == "pods":
+                # Carry the RPC's trace across the informer/queue
+                # boundary (no-op outside a span).
+                stamp_traceparent(obj)
+            obj = await self._admit(obj, request.resource, "create")
             obj = await self.store.create(request.resource, obj)
         except StoreError as e:
             await context.abort(_abort_code(e), str(e))
@@ -206,9 +211,12 @@ class AuthInterceptor(grpc.aio.ServerInterceptor):
     async def intercept_service(self, continuation, details):
         handler = await continuation(details)
         owner = self.owner
+        tracer = owner.tracer
+        tracing = tracer is not None and tracer.enabled
         if handler is None or (owner.bearer_tokens is None
                                and owner.authorizer is None
-                               and owner.audit is None):
+                               and owner.audit is None
+                               and not tracing):
             return handler  # chain disabled: raw service
         md = {k: v for k, v in (details.invocation_metadata or ())}
         method = details.method.rsplit("/", 1)[-1]
@@ -289,6 +297,18 @@ class AuthInterceptor(grpc.aio.ServerInterceptor):
             inner = handler.unary_unary
 
             async def uu(request, context):
+                if tracing:
+                    # gRPC-metadata traceparent (the interceptor-chain
+                    # analog of the HTTP traceparent header): the RPC's
+                    # server span parents to the caller's.
+                    resource = getattr(request, "resource", "") or "misc"
+                    with tracer.span(f"grpc.{verb}.{resource}",
+                                     traceparent=md.get("traceparent"),
+                                     user=user or "system:anonymous"):
+                        return await uu_chain(request, context)
+                return await uu_chain(request, context)
+
+            async def uu_chain(request, context):
                 actx = begin_audit(request)
                 if fail is not None:
                     # authn/impersonation denials are audited too — the
@@ -326,6 +346,18 @@ class AuthInterceptor(grpc.aio.ServerInterceptor):
             inner_stream = handler.unary_stream
 
             async def us(request, context):
+                if tracing:
+                    resource = getattr(request, "resource", "") or "misc"
+                    with tracer.span(f"grpc.{verb}.{resource}",
+                                     traceparent=md.get("traceparent"),
+                                     user=user or "system:anonymous"):
+                        async for item in us_chain(request, context):
+                            yield item
+                    return
+                async for item in us_chain(request, context):
+                    yield item
+
+            async def us_chain(request, context):
                 actx = begin_audit(request)
                 if fail is not None:
                     end_audit(actx, _GRPC_AUDIT_CODE.get(fail[0], 500))
@@ -390,7 +422,8 @@ class GRPCAPIServer:
                  port: int = 0, *,
                  bearer_tokens: Mapping[str, str] | None = None,
                  user_groups: Mapping[str, list] | None = None,
-                 authorizer=None, audit=None, admission=None):
+                 authorizer=None, audit=None, admission=None,
+                 tracer=None):
         self.store = store
         self.host = host
         self.port = port
@@ -403,6 +436,12 @@ class GRPCAPIServer:
                             (user_groups or {}).items()}
         self.authorizer = authorizer
         self.audit = audit
+        #: OTel-style per-RPC spans (§5.1), same process tracer as the
+        #: other wires by default.
+        if tracer is None:
+            from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+            tracer = DEFAULT_TRACER
+        self.tracer = tracer
         self._server: grpc.aio.Server | None = None
 
     def groups_for(self, user: str) -> list:
@@ -468,12 +507,23 @@ class GRPCRemoteStore:
             md.append(("impersonate-user", impersonate))
         self._metadata = tuple(md) or None
 
+    def _md(self):
+        """Per-call metadata: the static auth/impersonation pairs plus a
+        traceparent when the call is issued inside a span (client-go's
+        otelgrpc interceptor analog)."""
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        if DEFAULT_TRACER.enabled:
+            tp = DEFAULT_TRACER.current_traceparent()
+            if tp:
+                return (*(self._metadata or ()), ("traceparent", tp))
+        return self._metadata
+
     def _uu(self, method: str, req, resp_cls=ktpu_pb2.Unknown):
         return self._channel.unary_unary(
             f"/{_SERVICE}/{method}",
             request_serializer=type(req).SerializeToString,
             response_deserializer=resp_cls.FromString)(
-                req, metadata=self._metadata)
+                req, metadata=self._md())
 
     async def close(self) -> None:
         await self._channel.close()
@@ -565,7 +615,7 @@ class GRPCRemoteStore:
             resource=resource,
             resource_version=str(resource_version)
             if resource_version is not None else "",
-            label_selector=sel or ""), metadata=self._metadata)
+            label_selector=sel or ""), metadata=self._md())
 
         async def gen():
             try:

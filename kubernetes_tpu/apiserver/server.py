@@ -24,12 +24,15 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from collections import deque
 from typing import Mapping
 
 from aiohttp import web
 
 from kubernetes_tpu.api.labels import parse_field_selector, parse_selector
+from kubernetes_tpu.metrics.registry import APIServerMetrics
+from kubernetes_tpu.utils.tracing import stamp_traceparent
 from kubernetes_tpu.store.mvcc import (
     AlreadyExists,
     Conflict,
@@ -242,10 +245,15 @@ class APIServer:
         #: policy/audit.AuditPipeline or None = no stage-event audit
         #: (the legacy `audit_log` flat line remains available).
         self.audit = audit
+        #: apiserver_request_duration_seconds / current_inflight — one
+        #: instance shared with the KTPU wire (for_apiserver), so
+        #: /metrics shows the whole request load across both wires.
+        self.request_metrics = APIServerMetrics()
         if metrics_registry is not None:
             # Watch-dispatch counters live on the store (it owns dispatch);
             # surface them through this server's /metrics exposition.
             store.watch_metrics.register_into(metrics_registry)
+            self.request_metrics.register_into(metrics_registry)
             if audit is not None:
                 audit.register_into(metrics_registry)
             engine = getattr(admission, "policy_engine", None)
@@ -273,6 +281,7 @@ class APIServer:
         app = web.Application(middlewares=[
             self._mw_recovery,        # WithPanicRecovery
             self._mw_request_info,    # WithRequestInfo
+            self._mw_request_metrics,  # request duration + inflight (§5.5)
             self._mw_trace,           # WithTracing (OTel spans, §5.1)
             self._mw_authn,           # WithAuthentication
             self._mw_audit,           # WithAudit (stage events, §5.5)
@@ -343,6 +352,33 @@ class APIServer:
             "PATCH": "patch",
         }.get(request.method, request.method.lower())
         return await handler(request)
+
+    @web.middleware
+    async def _mw_request_metrics(self, request: web.Request, handler):
+        """apiserver_request_duration_seconds{verb,resource,code} +
+        apiserver_current_inflight_requests{request_kind}. Non-resource
+        paths (health, metrics, discovery) and long-running requests
+        (watches) are excluded from BOTH families — a watch's "duration"
+        is its stream lifetime, which would poison the latency
+        percentiles (and differ from the KTPU wire's registration-time
+        view of the same verb)."""
+        m = self.request_metrics
+        verb = request["verb"]
+        resource = request.get("resource", "")
+        if m is None or not resource or verb == "watch":
+            return await handler(request)
+        m.inc_inflight(verb)
+        t0 = time.perf_counter()
+        try:
+            resp = await handler(request)
+        except Exception as e:
+            m.observe(verb, resource, _code_reason(e)[0],
+                      time.perf_counter() - t0)
+            raise
+        finally:
+            m.dec_inflight(verb)
+        m.observe(verb, resource, resp.status, time.perf_counter() - t0)
+        return resp
 
     @web.middleware
     async def _mw_trace(self, request: web.Request, handler):
@@ -780,6 +816,10 @@ class APIServer:
                 meta = obj.get("metadata") or {}
                 ns = meta.get("namespace") or "default"
                 self.tracer.annotate(pod=f"{ns}/{meta.get('name', '')}")
+                # Carry this request's trace across the informer/queue
+                # boundary: the scheduler parents its attempt span to the
+                # stamped traceparent (no-op with tracing off).
+                stamp_traceparent(obj)
             if self.admission is not None:
                 with self.tracer.span("admission.webhooks",
                                       resource=resource, op="create"):

@@ -47,9 +47,11 @@ staging/src/k8s.io/apimachinery/pkg/watch, client-go transport/cache.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 import struct
+import time
 from typing import AsyncIterator, Callable, Mapping
 
 import msgpack
@@ -70,8 +72,11 @@ from kubernetes_tpu.store.mvcc import (
     NotFound,
     StoreError,
 )
+from kubernetes_tpu.utils.tracing import stamp_traceparent
 
 logger = logging.getLogger(__name__)
+
+_NULL_CM = contextlib.nullcontext()
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 << 20
@@ -265,6 +270,44 @@ class _Conn(asyncio.Protocol):
     def _err(self, rid: str, reason: str, message: str) -> None:
         self.send(_encode_reply([rid, "err", reason, message], self._mp))
 
+    @staticmethod
+    def _unwrap_traced(frame: list) -> tuple[str | None, list]:
+        """Frame-field traceparent (the wire analog of the HTTP
+        `traceparent` header): [id, "traced", tp, op, ...args] unwraps to
+        (tp, [id, op, ...args]); untraced frames pass through. A
+        non-string tp is dropped, not propagated — it would otherwise
+        crash span creation OUTSIDE the error-reply path and hang the
+        client's future."""
+        if len(frame) > 3 and frame[1] == "traced":
+            tp = frame[2] if isinstance(frame[2], str) else None
+            return tp, [frame[0], *frame[3:]]
+        return None, frame
+
+    def _span_cm(self, op: str, resource: str, tp: str | None):
+        """Server-side span for one frame op (a no-op context when the
+        tracer is off)."""
+        tracer = self.server.tracer
+        if tracer is None or not tracer.enabled or op in (
+                "hello", "stopwatch"):
+            return _NULL_CM
+        name = "wire.multi" if op == "multi" else \
+            f"wire.{_VERB_OF.get(op, op)}.{resource or 'misc'}"
+        return tracer.span(name, traceparent=tp, client=self.flow,
+                           user=self.user)
+
+    def _finish(self, actx, code: int, verb: str, resource: str,
+                t0: float, result=None) -> None:
+        """ResponseComplete + the request-duration observation — one call
+        per frame-op outcome, mirroring where the HTTP chain's audit
+        middleware and metrics middleware both fire. Watches are excluded
+        from the duration family on both wires: here the frame finishes
+        at registration, on HTTP at stream end — two incompatible
+        semantics that would share one metric."""
+        self._audit_end(actx, code, result)
+        m = self.server.request_metrics
+        if m is not None and resource and verb != "watch":
+            m.observe(verb, resource, code, time.perf_counter() - t0)
+
     # -- handler chain (server.py middleware order) ------------------------
 
     # -- audit stage events ------------------------------------------------
@@ -303,8 +346,21 @@ class _Conn(asyncio.Protocol):
             if self.user != self.auth_user else None)
 
     async def _handle(self, frame: list) -> None:
+        try:
+            tp, frame = self._unwrap_traced(frame)
+            op = frame[1]
+            resource = frame[2] if len(frame) > 2 and \
+                isinstance(frame[2], str) else ""
+        except Exception:
+            tp, op, resource = None, "", ""
+        with self._span_cm(op, resource, tp):
+            await self._handle_frame(frame)
+
+    async def _handle_frame(self, frame: list) -> None:
         rid = ""
         actx = None
+        verb = resource = ""
+        t0 = time.perf_counter()
         try:
             rid, op = frame[0], frame[1]
             if op == "hello":
@@ -329,13 +385,13 @@ class _Conn(asyncio.Protocol):
                         not srv.authorizer.allowed(
                             self.user, verb, resource,
                             groups=srv.groups_for(self.user)):
-                    self._audit_end(actx, 403)
+                    self._finish(actx, 403, verb, resource, t0)
                     return self._err(
                         rid, "Forbidden",
                         f'user "{self.user}" cannot {verb} resource '
                         f'"{resource}"')
                 await self._start_watch(rid, frame[2], frame[3] or {})
-                self._audit_end(actx, 200)
+                self._finish(actx, 200, verb, resource, t0)
                 return
             # APF: watches hold no seat (cacher semantics); everything
             # else acquires one from the shared priority levels.
@@ -344,7 +400,7 @@ class _Conn(asyncio.Protocol):
                 try:
                     await level.acquire(self.flow)
                 except Exception:
-                    self._audit_end(actx, 429)
+                    self._finish(actx, 429, verb, resource, t0)
                     return self._err(rid, "TooManyRequests",
                                      f"priority level {level.name!r} "
                                      "queue full")
@@ -355,29 +411,38 @@ class _Conn(asyncio.Protocol):
                         not srv.authorizer.allowed(
                             self.user, verb, resource,
                             groups=srv.groups_for(self.user)):
-                    self._audit_end(actx, 403)
+                    self._finish(actx, 403, verb, resource, t0)
                     return self._err(
                         rid, "Forbidden",
                         f'user "{self.user}" cannot {verb} resource '
                         f'"{resource}"')
-                result = await self._dispatch(op, frame)
+                m = srv.request_metrics
+                if m is not None:
+                    m.inc_inflight(verb)
+                try:
+                    result = await self._dispatch(op, frame)
+                finally:
+                    if m is not None:
+                        m.dec_inflight(verb)
             finally:
                 if level is not None:
                     level.release()
-            self._audit_end(actx, 200 if op != "create" else 201, result)
+            self._finish(actx, 200 if op != "create" else 201,
+                         verb, resource, t0, result)
             self._ok(rid, result)
         except StoreError as e:
             reason = _reason_for(e)
-            self._audit_end(actx, _CODE_OF_REASON.get(reason, 500))
+            self._finish(actx, _CODE_OF_REASON.get(reason, 500),
+                         verb, resource, t0)
             self._err(rid, reason, str(e))
         except asyncio.CancelledError:
             raise
         except (ValueError, KeyError, IndexError, TypeError) as e:
-            self._audit_end(actx, 400)
+            self._finish(actx, 400, verb, resource, t0)
             self._err(rid, "BadRequest", f"malformed frame: {e!r}")
         except Exception:
             logger.exception("wire: panic handling frame")
-            self._audit_end(actx, 500)
+            self._finish(actx, 500, verb, resource, t0)
             self._err(rid, "InternalError", "internal error")
 
     async def _multi(self, rid: str, ops: list) -> None:
@@ -388,6 +453,18 @@ class _Conn(asyncio.Protocol):
         ["ok", result] | ["err", reason, message] pairs."""
         srv = self.server
         results: list = [None] * len(ops)
+        # Per-member traceparents (the traced wrapper applies to multi
+        # members too — each member is one request, so each gets its own
+        # server span parented to its caller's span).
+        member_tps: list[str | None] = [None] * len(ops)
+        unwrapped: list = []
+        for i, sub in enumerate(ops):
+            if len(sub) > 2 and sub[0] == "traced":
+                if isinstance(sub[1], str):  # see _unwrap_traced
+                    member_tps[i] = sub[1]
+                sub = list(sub[2:])
+            unwrapped.append(sub)
+        ops = unwrapped
         # Seats are held PER PRIORITY LEVEL, matching the single-op path:
         # a lease renewal coalesced into the same tick as a pod burst must
         # still ride the "system" level, or a full workload queue would
@@ -417,36 +494,48 @@ class _Conn(asyncio.Protocol):
                     sub = ops[idx]
                     op = sub[0]
                     actx = None
+                    verb = resource = ""
+                    t0 = time.perf_counter()
                     try:
                         resource = sub[1] if len(sub) > 1 and \
                             isinstance(sub[1], str) else ""
                         verb = _VERB_OF.get(op, op)
-                        # Per-op audit, same stages as the single-op path
-                        # (one coalesced frame is still N requests).
-                        actx = self._audit_begin(op, verb, resource,
-                                                 ["", *sub])
-                        if srv.authorizer is not None and resource and \
-                                not srv.authorizer.allowed(
-                                    self.user, verb, resource,
-                                    groups=srv.groups_for(self.user)):
-                            self._audit_end(actx, 403)
-                            results[idx] = [
-                                "err", "Forbidden",
-                                f'user "{self.user}" cannot {verb} '
-                                f'resource "{resource}"']
-                            continue
-                        result = await self._dispatch(op, ["", *sub])
-                        self._audit_end(
-                            actx, 200 if op != "create" else 201, result)
-                        results[idx] = ["ok", result]
+                        with self._span_cm(op, resource, member_tps[idx]):
+                            # Per-op audit, same stages as the single-op
+                            # path (one coalesced frame is N requests).
+                            actx = self._audit_begin(op, verb, resource,
+                                                     ["", *sub])
+                            if srv.authorizer is not None and resource \
+                                    and not srv.authorizer.allowed(
+                                        self.user, verb, resource,
+                                        groups=srv.groups_for(self.user)):
+                                self._finish(actx, 403, verb, resource, t0)
+                                results[idx] = [
+                                    "err", "Forbidden",
+                                    f'user "{self.user}" cannot {verb} '
+                                    f'resource "{resource}"']
+                                continue
+                            m = srv.request_metrics
+                            if m is not None:
+                                m.inc_inflight(verb)
+                            try:
+                                result = await self._dispatch(
+                                    op, ["", *sub])
+                            finally:
+                                if m is not None:
+                                    m.dec_inflight(verb)
+                            self._finish(
+                                actx, 200 if op != "create" else 201,
+                                verb, resource, t0, result)
+                            results[idx] = ["ok", result]
                     except StoreError as e:
                         reason = _reason_for(e)
-                        self._audit_end(
-                            actx, _CODE_OF_REASON.get(reason, 500))
+                        self._finish(actx, _CODE_OF_REASON.get(reason, 500),
+                                     verb, resource, t0)
                         results[idx] = ["err", reason, str(e)]
                     except (ValueError, KeyError, IndexError,
                             TypeError) as e:
-                        self._audit_end(actx, 400)
+                        self._finish(actx, 400, verb, resource, t0)
                         results[idx] = ["err", "BadRequest",
                                         f"malformed op: {e!r}"]
             finally:
@@ -515,6 +604,11 @@ class _Conn(asyncio.Protocol):
             if admission is not None else None
         if op == "create":
             resource, obj = frame[2], frame[3]
+            if resource == "pods":
+                # Carry this frame's trace across the informer/queue
+                # boundary (see utils/tracing.stamp_traceparent); no-op
+                # outside a span.
+                stamp_traceparent(obj)
             if admission is not None:
                 obj = await admission.admit(obj, resource, "create",
                                             user=user, groups=groups)
@@ -659,7 +753,8 @@ class WireServer:
                  bearer_tokens: Mapping[str, str] | None = None,
                  token_authenticator=None,
                  user_groups: Mapping[str, list[str]] | None = None,
-                 authorizer=None, admission=None, audit=None):
+                 authorizer=None, admission=None, audit=None,
+                 tracer=None, request_metrics=None):
         self.store = store
         self.host = host
         self.port = port
@@ -673,6 +768,15 @@ class WireServer:
         #: policy/audit.AuditPipeline or None (shared with the HTTP
         #: server via for_apiserver — ONE sink for both wires).
         self.audit = audit
+        #: OTel-style per-frame spans (§5.1) — the frame-field analog of
+        #: the HTTP wire's traceparent middleware.
+        if tracer is None:
+            from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+            tracer = DEFAULT_TRACER
+        self.tracer = tracer
+        #: APIServerMetrics shared with the HTTP server (for_apiserver):
+        #: both wires report into one request-duration family.
+        self.request_metrics = request_metrics
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_Conn] = set()
         self._path = ""
@@ -688,7 +792,8 @@ class WireServer:
                    token_authenticator=api.token_authenticator,
                    user_groups=api.user_groups,
                    authorizer=api.authorizer, admission=api.admission,
-                   audit=api.audit)
+                   audit=api.audit, tracer=api.tracer,
+                   request_metrics=api.request_metrics)
 
     def classify(self, resource: str):
         if not self.priority_levels:
@@ -1011,6 +1116,18 @@ class WireStore:
             exc = _EXC_OF.get(frame[2], StoreError)
             fut.set_exception(exc(frame[3]))
 
+    @staticmethod
+    def _trace_wrap(op_frame: list) -> list:
+        """W3C traceparent propagation, frame-field form: an op issued
+        inside a span ships ["traced", tp, op, ...args] so the server's
+        frame span parents to the caller's (the wire analog of
+        RemoteStore's traceparent header)."""
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        if not DEFAULT_TRACER.enabled:
+            return op_frame
+        tp = DEFAULT_TRACER.current_traceparent()
+        return ["traced", tp, *op_frame] if tp else op_frame
+
     async def _call(self, op: str, *args, _pre_auth: bool = False):
         if not _pre_auth:
             await self._ensure()
@@ -1021,7 +1138,7 @@ class WireStore:
         if _pre_auth:
             self._send([rid, op, *args])  # hello must not ride a multi
         else:
-            self._send_op(rid, [op, *args])
+            self._send_op(rid, self._trace_wrap([op, *args]))
         return await fut
 
     # -- MVCCStore surface -------------------------------------------------

@@ -18,6 +18,18 @@ from collections import defaultdict
 from typing import Iterable, Mapping
 
 
+def _esc_label(value) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — exposition-format.md's only three escapes."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _esc_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline (quotes are legal there)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class Counter:
     def __init__(self, name: str, help_: str = "", labels: Iterable[str] = ()):
         self.name = name
@@ -42,12 +54,20 @@ class Counter:
         key = tuple(labels.get(n, "") for n in self.label_names)
         return self._values.get(key, 0.0)
 
-    def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+    def _render(self, type_: str) -> str:
+        # The TYPE line is written explicitly per metric type: deriving it
+        # by string replacement corrupted the HELP line whenever the help
+        # text itself contained the word "counter".
+        lines = [f"# HELP {self.name} {_esc_help(self.help)}",
+                 f"# TYPE {self.name} {type_}"]
         for key, v in sorted(self._values.items()):
-            lbl = ",".join(f'{n}="{val}"' for n, val in zip(self.label_names, key))
+            lbl = ",".join(f'{n}="{_esc_label(val)}"'
+                           for n, val in zip(self.label_names, key))
             lines.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
         return "\n".join(lines)
+
+    def render(self) -> str:
+        return self._render("counter")
 
 
 class Gauge(Counter):
@@ -57,7 +77,7 @@ class Gauge(Counter):
             self._values[key] = value
 
     def render(self) -> str:
-        return super().render().replace("counter", "gauge", 1)
+        return self._render("gauge")
 
 
 _DEFAULT_BUCKETS = tuple(0.001 * (2 ** i) for i in range(16))  # 1ms .. ~32s
@@ -135,9 +155,11 @@ class Histogram:
         return self._sums.get(key, 0.0)
 
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        lines = [f"# HELP {self.name} {_esc_help(self.help)}",
+                 f"# TYPE {self.name} histogram"]
         for key in sorted(self._totals):
-            base = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, key))
+            base = ",".join(f'{n}="{_esc_label(v)}"'
+                            for n, v in zip(self.label_names, key))
             counts = self._cumulative(key)
             for b, c in zip(self.buckets, counts):
                 sep = "," if base else ""
@@ -147,6 +169,63 @@ class Histogram:
             lines.append(f"{self.name}_sum{{{base}}} {self._sums[key]}")
             lines.append(f"{self.name}_count{{{base}}} {self._totals[key]}")
         return "\n".join(lines)
+
+
+class WindowedLatencyRecorder:
+    """Exact windowed percentiles from raw observations (ROADMAP #3's
+    p999 prerequisite): a bounded ring of the last `capacity` values,
+    read by (mark, percentiles_since) pairs the way the bench uses
+    Histogram.snapshot/percentile_since — but returning TRUE order
+    statistics instead of bucket edges, which a 16-bucket power-of-two
+    histogram cannot resolve at p999.
+
+    observe() is deliberately lock-free — one slot write + one integer
+    increment, GIL-atomic in practice — so the recorder stays off the
+    histogram lock's hot path; a racing observer can at worst overwrite
+    one sample, never corrupt the ring. Windows larger than the capacity
+    degrade to the newest `capacity` observations (the tail is what the
+    high quantiles need)."""
+
+    __slots__ = ("capacity", "_buf", "_n")
+
+    def __init__(self, capacity: int = 1 << 17):
+        self.capacity = capacity
+        self._buf = [0.0] * capacity
+        self._n = 0
+
+    def observe(self, value: float) -> None:
+        i = self._n
+        self._buf[i % self.capacity] = value
+        self._n = i + 1
+
+    def mark(self) -> int:
+        """Window-start marker; pass to percentiles_since."""
+        return self._n
+
+    def count_since(self, mark: int) -> int:
+        return self._n - mark
+
+    def percentiles_since(self, mark: int,
+                          qs: Iterable[float]) -> dict[float, float]:
+        """Exact percentiles over observations after `mark` (nearest-rank
+        on the sorted window). NaN when the window is empty; windows
+        beyond capacity use the newest `capacity` values."""
+        n = self._n
+        window = n - mark
+        if window <= 0:
+            return {q: math.nan for q in qs}
+        take = min(window, self.capacity)
+        cap = self.capacity
+        if n <= cap:
+            vals = self._buf[n - take:n]
+        else:
+            lo = (n - take) % cap
+            hi = n % cap
+            vals = self._buf[lo:] + self._buf[:hi] if lo >= hi \
+                else self._buf[lo:hi]
+        vals.sort()
+        return {q: vals[min(max(math.ceil(q * take) - 1, 0), take - 1)]
+                for q in qs}
 
 
 class Registry:
@@ -206,6 +285,55 @@ class WatchMetrics:
         for c in (self.events_dispatched, self.predicate_checks,
                   self.index_hits):
             registry._metrics.setdefault(c.name, c)
+
+
+#: verbs counted as mutating for apiserver_current_inflight_requests'
+#: request_kind label (the reference's mutating/readOnly split).
+_MUTATING_VERBS = frozenset(("create", "update", "patch", "delete"))
+
+
+class APIServerMetrics:
+    """The apiserver request metric families (SURVEY §5.5's dashboard
+    contract): request latency by verb/resource/code and the in-flight
+    gauge by request kind. Emitted from BOTH serving paths — the HTTP
+    middleware chain and the KTPU wire's frame handler — into one shared
+    instance, so /metrics shows the server's whole request load no matter
+    which wire carried it. Long-running requests (watches) are excluded
+    from both families: inflight like the reference, and duration
+    because a watch's "latency" is its stream lifetime (and the two
+    wires would otherwise report incompatible views of the same verb)."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or Registry()
+        self.registry = r
+        self.request_duration = r.histogram(
+            "apiserver_request_duration_seconds",
+            "Response latency distribution by verb, resource and "
+            "HTTP-equivalent status code",
+            labels=("verb", "resource", "code"))
+        self.inflight = r.gauge(
+            "apiserver_current_inflight_requests",
+            "Currently executing (non-long-running) requests",
+            labels=("request_kind",))
+
+    def register_into(self, registry: Registry) -> None:
+        for m in (self.request_duration, self.inflight):
+            registry._metrics.setdefault(m.name, m)
+
+    @staticmethod
+    def _kind(verb: str) -> str:
+        return "mutating" if verb in _MUTATING_VERBS else "readOnly"
+
+    def observe(self, verb: str, resource: str, code: int,
+                seconds: float) -> None:
+        self.request_duration.observe(
+            seconds, verb=verb, resource=resource, code=str(code))
+
+    def inc_inflight(self, verb: str) -> None:
+        self.inflight.inc(1, request_kind=self._kind(verb))
+
+    def dec_inflight(self, verb: str) -> None:
+        self.inflight.inc(-1, request_kind=self._kind(verb))
 
 
 class SchedulerMetrics:
@@ -271,12 +399,34 @@ class SchedulerMetrics:
             "scheduler_tpu_solver_shortlist_fallbacks_total",
             "Pods whose shortlist bound check fell back to the full row")
 
+        #: exact windowed percentile recorders riding attempt_duration's
+        #: observe path, keyed by (result, profile) — the same population
+        #: split as the histogram's labels, so the bench's exact
+        #: percentiles replace the bucket-edge values one-for-one.
+        #: Lock-free ring appends (see WindowedLatencyRecorder).
+        self.attempt_windows: dict[
+            tuple[str, str], WindowedLatencyRecorder] = {}
+
+    def attempt_window(self, result: str = "scheduled",
+                       profile: str = "default-scheduler") \
+            -> WindowedLatencyRecorder:
+        key = (result, profile)
+        w = self.attempt_windows.get(key)
+        if w is None:
+            w = self.attempt_windows[key] = WindowedLatencyRecorder()
+        return w
+
     def observe_plugin(self, plugin: str, point: str, seconds: float) -> None:
         self.plugin_duration.observe(seconds, plugin=plugin, extension_point=point)
 
     def observe_attempt(self, result: str, profile: str, seconds: float) -> None:
         self.schedule_attempts.inc(result=result, profile=profile)
         self.attempt_duration.observe(seconds, result=result, profile=profile)
+        key = (result, profile)
+        w = self.attempt_windows.get(key)
+        if w is None:
+            w = self.attempt_windows[key] = WindowedLatencyRecorder()
+        w.observe(seconds)
 
     def set_pending(self, stats: Mapping[str, int]) -> None:
         for queue, n in stats.items():

@@ -39,6 +39,7 @@ stateful constraints); violators are returned unassigned and requeue — the
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -64,6 +65,16 @@ from kubernetes_tpu.scheduler.plugins.noderesources import (
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
 
 logger = logging.getLogger(__name__)
+
+# jax.profiler host annotations (SURVEY §5.1): bracket the solve
+# dispatch/fetch so device-solve chunks appear in the SAME jax-profiler
+# timeline as the host-side work when a --profile-dir trace is taken.
+# TraceMe-backed — near-free when no trace is active.
+try:
+    _TRACE_ANNOTATION = jax.profiler.TraceAnnotation
+    _STEP_ANNOTATION = jax.profiler.StepTraceAnnotation
+except AttributeError:  # pragma: no cover - stripped-down jax builds
+    _TRACE_ANNOTATION = _STEP_ANNOTATION = None
 
 #: Plugins with full device kernels.
 DEVICE_FILTER_PLUGINS = {"NodeResourcesFit", "TaintToleration"}
@@ -539,6 +550,10 @@ class TPUBackend:
         #: SchedulerMetrics, injected by the Scheduler — degradation
         #: counters (spread poisoning, gang overflow) report through it.
         self.metrics = None
+        #: utils/tracing.Tracer, injected by Scheduler.attach_backend —
+        #: per-chunk solver.dispatch/solver.solve spans nest under the
+        #: scheduler's attempt span when tracing is on.
+        self.tracer = None
         # Multi-device: shard the nodes axis over an ICI mesh
         # (SURVEY §5.7 — the TP-like axis). Inputs are placed with
         # NamedSharding and the SAME jit program auto-partitions (XLA
@@ -1291,8 +1306,17 @@ class TPUBackend:
         for that blind spot: scheduler_tpu_solve_seconds per chunk, plus
         the solver scan width / shortlist fallback counters extracted
         from the same fetch in _finalize_chunk."""
+        tr = self.tracer
+        span = tr.span("solver.solve", chunk=run.get("chunk_idx"),
+                       pods=run["batch"].p_real) \
+            if tr is not None and tr.enabled else contextlib.nullcontext()
         t0 = time.perf_counter()
-        got = np.asarray(run["assign_d"])
+        with span:
+            if _TRACE_ANNOTATION is not None:
+                with _TRACE_ANNOTATION("ktpu.solve.fetch"):
+                    got = np.asarray(run["assign_d"])
+            else:
+                got = np.asarray(run["assign_d"])
         run["solve_wall_s"] = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.solve_duration.observe(run["solve_wall_s"])
@@ -2049,7 +2073,24 @@ class TPUBackend:
 
     def _dispatch_chunk(self, prep: dict, ctx: "_AssignCtx") -> dict:
         """Dispatch the fused solve for one chunk; device used-state chains
-        through self._dev_used without host sync."""
+        through self._dev_used without host sync. Bracketed with a
+        StepTraceAnnotation (one profiler step per chunk) and, when
+        tracing is on, a solver.dispatch span under the attempt."""
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            with tr.span("solver.dispatch", chunk=prep.get("chunk_idx"),
+                         pods=prep["batch"].p_real):
+                return self._dispatch_chunk_inner(prep, ctx)
+        return self._dispatch_chunk_inner(prep, ctx)
+
+    def _dispatch_chunk_inner(self, prep: dict, ctx: "_AssignCtx") -> dict:
+        if _STEP_ANNOTATION is not None:
+            with _STEP_ANNOTATION("ktpu.solve",
+                                  step_num=prep.get("chunk_idx", 0)):
+                return self._dispatch_chunk_jit(prep, ctx)
+        return self._dispatch_chunk_jit(prep, ctx)
+
+    def _dispatch_chunk_jit(self, prep: dict, ctx: "_AssignCtx") -> dict:
         ct, p = ctx.ct, ctx.params
         batch = prep["batch"]
         if self._dev_static_fp != ct._static_fp or \

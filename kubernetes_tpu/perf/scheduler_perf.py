@@ -72,6 +72,13 @@ class WorkloadResult:
         self.attempt_p50 = 0.0
         self.attempt_p90 = 0.0
         self.attempt_p99 = 0.0
+        #: exact-only (the 16-bucket histogram cannot resolve it): true
+        #: p999 attempt latency over the measured window — the ROADMAP #3
+        #: churn-battery headline percentile.
+        self.attempt_p999 = float("nan")
+        #: True when p50/p90/p99/p999 came from the exact windowed
+        #: recorder (raw order statistics) rather than bucket edges.
+        self.attempt_percentiles_exact = False
         self.fragmentation_pct = 0.0
         self.scheduled_total = 0
         self.unschedulable_total = 0
@@ -126,6 +133,8 @@ class WorkloadResult:
             "attempt_p50_ms": ms(self.attempt_p50),
             "attempt_p90_ms": ms(self.attempt_p90),
             "attempt_p99_ms": ms(self.attempt_p99),
+            "attempt_p999_ms": ms(self.attempt_p999),
+            "attempt_percentiles_exact": self.attempt_percentiles_exact,
             "fragmentation_pct": round(self.fragmentation_pct, 2),
             "scheduled_total": self.scheduled_total,
             "unschedulable_total": self.unschedulable_total,
@@ -609,7 +618,8 @@ class PerfRunner:
             metrics.solve_duration.count(),
             metrics.solve_duration.sum(),
             metrics.solver_shortlist_pods.value(),
-            metrics.solver_shortlist_fallbacks.value())
+            metrics.solver_shortlist_fallbacks.value(),
+            metrics.attempt_window().mark())
 
     def _end_measure(self, result: WorkloadResult,
                      metrics: SchedulerMetrics,
@@ -617,7 +627,7 @@ class PerfRunner:
         (hist_base, t0, fallback_base, poisoned_base,
          dispatched_base, checks_base, evals_base, audits_base,
          solve_chunks_base, solve_s_base, sl_pods_base,
-         sl_fall_base) = window
+         sl_fall_base, window_mark) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
         result.measured_seconds = dt
@@ -627,6 +637,20 @@ class PerfRunner:
         result.attempt_p50 = h.percentile_since(0.50, hist_base, **labels)
         result.attempt_p90 = h.percentile_since(0.90, hist_base, **labels)
         result.attempt_p99 = h.percentile_since(0.99, hist_base, **labels)
+        # TRUE order-statistic percentiles over the measured window (the
+        # exact recorder riding attempt_duration's observe path); the
+        # bucket-edge values above remain only as the fallback when no
+        # scheduled attempt landed in the window.
+        win = metrics.attempt_window()
+        exact = win.percentiles_since(window_mark,
+                                      (0.50, 0.90, 0.99, 0.999))
+        import math
+        if not math.isnan(exact[0.50]):
+            result.attempt_p50 = exact[0.50]
+            result.attempt_p90 = exact[0.90]
+            result.attempt_p99 = exact[0.99]
+            result.attempt_p999 = exact[0.999]
+            result.attempt_percentiles_exact = True
         deg = metrics.backend_degradations
         result.host_fallback_pods = int(
             deg.value(kind="host_fallback") - fallback_base)

@@ -41,6 +41,7 @@ import time
 from typing import Any, Mapping
 
 from kubernetes_tpu.metrics.registry import Registry
+from kubernetes_tpu.utils import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -280,6 +281,16 @@ class AuditPipeline:
                           "namespace": namespace or "",
                           "name": name or ""},
         }
+        # Trace ↔ audit correlation (§5.1 ↔ §5.5): when this request runs
+        # inside a span, the audit event carries the span's traceparent
+        # annotation and the span carries the auditID attribute — one
+        # pod's create→admit→schedule→bind path joins on either key.
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.attrs.setdefault("audit_id", ctx["auditID"])
+            ctx["annotations"] = {
+                "traceparent": tracing.format_traceparent(
+                    sp.trace_id, sp.span_id)}
         if level_at_least(level, LEVEL_REQUEST) and \
                 request_object is not None:
             ctx["requestObject"] = request_object

@@ -25,10 +25,15 @@ selection the north star's feature gate demands.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 from typing import Any, Callable, Iterable, Mapping
 
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+
+#: shared no-op context manager (stateless, safe to re-enter): the
+#: disabled-tracer fast path of ep_span costs one attribute check + this.
+_NULL_CM = contextlib.nullcontext()
 
 # --- Status codes (framework.Code) -----------------------------------------
 
@@ -229,6 +234,10 @@ class Framework:
         self.plugins = plugins
         self.score_weights = dict(score_weights or {})
         self.metrics = metrics
+        #: utils/tracing.Tracer injected by the Scheduler (like metrics):
+        #: each extension-point run_* becomes a child span of the attempt
+        #: when tracing is on; a None/disabled tracer costs one check.
+        self.tracer = None
         disabled = {k: set(v) for k, v in (disabled or {}).items()}
 
         def enabled(point: str) -> list[Plugin]:
@@ -248,6 +257,15 @@ class Framework:
         self.pre_bind_plugins = enabled("PreBind")
         self.bind_plugins = enabled("Bind")
         self.post_bind_plugins = enabled("PostBind")
+
+    def ep_span(self, point: str):
+        """Context manager for one extension point's span (a no-op unless
+        the injected tracer is enabled) — the utiltrace step analog at
+        span granularity; per-plugin timing stays on the metrics path."""
+        t = self.tracer
+        if t is not None and t.enabled:
+            return t.span(f"framework.{point}", profile=self.profile_name)
+        return _NULL_CM
 
     def _timed(self, plugin: Plugin, point: str, fn: Callable, *args):
         t0 = time.perf_counter()
@@ -276,14 +294,16 @@ class Framework:
 
     def run_pre_filter(self, state: CycleState, pod: PodInfo,
                        snapshot: Snapshot) -> Status:
-        for p in self.pre_filter_plugins:
-            st = self._timed(p, "PreFilter", p.pre_filter, state, pod, snapshot)
-            if st.is_skip():
-                state.skip_filter_plugins.add(p.NAME)
-                continue
-            if not st.is_success():
-                return st.with_plugin(p.NAME)
-        return Status.success()
+        with self.ep_span("PreFilter"):
+            for p in self.pre_filter_plugins:
+                st = self._timed(p, "PreFilter", p.pre_filter, state, pod,
+                                 snapshot)
+                if st.is_skip():
+                    state.skip_filter_plugins.add(p.NAME)
+                    continue
+                if not st.is_success():
+                    return st.with_plugin(p.NAME)
+            return Status.success()
 
     def run_filters(self, state: CycleState, pod: PodInfo,
                     node: NodeInfo) -> Status:
@@ -298,53 +318,61 @@ class Framework:
     def run_post_filters(self, state: CycleState, pod: PodInfo,
                          snapshot: Snapshot,
                          statuses: Mapping[str, Status]) -> tuple[str, Status]:
-        for p in self.post_filter_plugins:
-            nominated, st = self._timed(
-                p, "PostFilter", p.post_filter, state, pod, snapshot, statuses)
-            if st.is_success() or not st.is_unschedulable():
-                return nominated, st.with_plugin(p.NAME)
-        return "", Status.unschedulable()
+        with self.ep_span("PostFilter"):
+            for p in self.post_filter_plugins:
+                nominated, st = self._timed(
+                    p, "PostFilter", p.post_filter, state, pod, snapshot,
+                    statuses)
+                if st.is_success() or not st.is_unschedulable():
+                    return nominated, st.with_plugin(p.NAME)
+            return "", Status.unschedulable()
 
     def run_pre_score(self, state: CycleState, pod: PodInfo,
                       nodes: list[NodeInfo]) -> Status:
-        for p in self.pre_score_plugins:
-            st = self._timed(p, "PreScore", p.pre_score, state, pod, nodes)
-            if st.is_skip():
-                state.skip_score_plugins.add(p.NAME)
-                continue
-            if not st.is_success():
-                return st.with_plugin(p.NAME)
-        return Status.success()
+        with self.ep_span("PreScore"):
+            for p in self.pre_score_plugins:
+                st = self._timed(p, "PreScore", p.pre_score, state, pod, nodes)
+                if st.is_skip():
+                    state.skip_score_plugins.add(p.NAME)
+                    continue
+                if not st.is_success():
+                    return st.with_plugin(p.NAME)
+            return Status.success()
 
     def run_scores(self, state: CycleState, pod: PodInfo,
                    nodes: list[NodeInfo]) -> dict[str, float]:
         """Weighted sum over score plugins (RunScorePlugins + NormalizeScore +
         plugin weight application)."""
-        totals = {n.name: 0.0 for n in nodes}
-        for p in self.score_plugins:
-            if p.NAME in state.skip_score_plugins:
-                continue
-            raw = {}
-            for n in nodes:
-                raw[n.name] = self._timed(p, "Score", p.score, state, pod, n)
-            self._timed(p, "NormalizeScore", p.normalize_scores, state, pod, raw)
-            w = self.score_weights.get(p.NAME, 1)
-            for name, s in raw.items():
-                totals[name] += w * s
-        return totals
+        with self.ep_span("Score"):
+            totals = {n.name: 0.0 for n in nodes}
+            for p in self.score_plugins:
+                if p.NAME in state.skip_score_plugins:
+                    continue
+                raw = {}
+                for n in nodes:
+                    raw[n.name] = self._timed(p, "Score", p.score, state,
+                                              pod, n)
+                self._timed(p, "NormalizeScore", p.normalize_scores, state,
+                            pod, raw)
+                w = self.score_weights.get(p.NAME, 1)
+                for name, s in raw.items():
+                    totals[name] += w * s
+            return totals
 
     # -- reserve / permit / bind --
 
     def run_reserve(self, state: CycleState, pod: PodInfo, node_name: str) -> Status:
-        done: list[Plugin] = []
-        for p in self.reserve_plugins:
-            st = self._timed(p, "Reserve", p.reserve, state, pod, node_name)
-            if not st.is_success():
-                for q in done:
-                    q.unreserve(state, pod, node_name)
-                return st.with_plugin(p.NAME)
-            done.append(p)
-        return Status.success()
+        with self.ep_span("Reserve"):
+            done: list[Plugin] = []
+            for p in self.reserve_plugins:
+                st = self._timed(p, "Reserve", p.reserve, state, pod,
+                                 node_name)
+                if not st.is_success():
+                    for q in done:
+                        q.unreserve(state, pod, node_name)
+                    return st.with_plugin(p.NAME)
+                done.append(p)
+            return Status.success()
 
     def run_unreserve(self, state: CycleState, pod: PodInfo, node_name: str) -> None:
         for p in reversed(self.reserve_plugins):
@@ -352,42 +380,48 @@ class Framework:
 
     def run_permit(self, state: CycleState, pod: PodInfo,
                    node_name: str) -> tuple[Status, float]:
-        max_timeout = 0.0
-        waiting = False
-        for p in self.permit_plugins:
-            st, timeout = self._timed(p, "Permit", p.permit, state, pod, node_name)
-            if st.is_wait():
-                waiting = True
-                max_timeout = max(max_timeout, timeout)
-            elif not st.is_success():
-                return st.with_plugin(p.NAME), 0.0
-        return (Status.wait(), max_timeout) if waiting else (Status.success(), 0.0)
+        with self.ep_span("Permit"):
+            max_timeout = 0.0
+            waiting = False
+            for p in self.permit_plugins:
+                st, timeout = self._timed(p, "Permit", p.permit, state, pod,
+                                          node_name)
+                if st.is_wait():
+                    waiting = True
+                    max_timeout = max(max_timeout, timeout)
+                elif not st.is_success():
+                    return st.with_plugin(p.NAME), 0.0
+            return (Status.wait(), max_timeout) if waiting \
+                else (Status.success(), 0.0)
 
     async def run_pre_bind(self, state: CycleState, pod: PodInfo,
                            node_name: str) -> Status:
-        for p in self.pre_bind_plugins:
-            t0 = time.perf_counter()
-            st = await p.pre_bind(state, pod, node_name)
-            if self.metrics is not None:
-                self.metrics.observe_plugin(p.NAME, "PreBind",
-                                            time.perf_counter() - t0)
-            if not st.is_success():
-                return st.with_plugin(p.NAME)
-        return Status.success()
+        with self.ep_span("PreBind"):
+            for p in self.pre_bind_plugins:
+                t0 = time.perf_counter()
+                st = await p.pre_bind(state, pod, node_name)
+                if self.metrics is not None:
+                    self.metrics.observe_plugin(p.NAME, "PreBind",
+                                                time.perf_counter() - t0)
+                if not st.is_success():
+                    return st.with_plugin(p.NAME)
+            return Status.success()
 
     async def run_bind(self, state: CycleState, pod: PodInfo,
                        node_name: str) -> Status:
-        for p in self.bind_plugins:
-            t0 = time.perf_counter()
-            st = await p.bind(state, pod, node_name)
-            if self.metrics is not None:
-                self.metrics.observe_plugin(p.NAME, "Bind",
-                                            time.perf_counter() - t0)
-            if st.is_skip():
-                continue
-            return st.with_plugin(p.NAME)
-        return Status.error("no bind plugin handled the pod")
+        with self.ep_span("Bind"):
+            for p in self.bind_plugins:
+                t0 = time.perf_counter()
+                st = await p.bind(state, pod, node_name)
+                if self.metrics is not None:
+                    self.metrics.observe_plugin(p.NAME, "Bind",
+                                                time.perf_counter() - t0)
+                if st.is_skip():
+                    continue
+                return st.with_plugin(p.NAME)
+            return Status.error("no bind plugin handled the pod")
 
     def run_post_bind(self, state: CycleState, pod: PodInfo, node_name: str) -> None:
-        for p in self.post_bind_plugins:
-            self._timed(p, "PostBind", p.post_bind, state, pod, node_name)
+        with self.ep_span("PostBind"):
+            for p in self.post_bind_plugins:
+                self._timed(p, "PostBind", p.post_bind, state, pod, node_name)

@@ -103,6 +103,9 @@ class SchedulingQueue:
     def _push_active(self, pi: PodInfo) -> None:
         if pi.key in self._active_keys:
             return
+        # Every activeQ entry (first add, backoff flush, move_all) stamps
+        # the queue-wait start for this attempt's retroactive span.
+        pi.enqueued_at = self.clock()
         heapq.heappush(self._active, (self._sort_key(pi), next(self._seq), pi))
         self._active_keys.add(pi.key)
 
@@ -174,10 +177,14 @@ class SchedulingQueue:
             if self._closed and not self._active:
                 return []
             out: list[PodInfo] = []
+            now = self.clock()
             while self._active and len(out) < max_pods:
                 _, _, pi = heapq.heappop(self._active)
                 self._active_keys.discard(pi.key)
                 pi.attempts += 1
+                # Queue-wait endpoint for the attempt's retroactive
+                # scheduler.queue.wait span (queued_at → dequeued_at).
+                pi.dequeued_at = now
                 self._in_flight.add(pi.key)
                 out.append(pi)
             return out

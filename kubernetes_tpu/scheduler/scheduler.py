@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 import time
 from typing import Mapping
@@ -45,6 +46,7 @@ from kubernetes_tpu.scheduler.plugins.registry import (
 from kubernetes_tpu.scheduler.queue import ClusterEvent, SchedulingQueue
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
 from kubernetes_tpu.utils.trace import Trace
+from kubernetes_tpu.utils.tracing import traceparent_of
 
 logger = logging.getLogger(__name__)
 
@@ -88,11 +90,15 @@ class Scheduler:
         backend=None,
         pod_initial_backoff: float = 1.0,
         pod_max_backoff: float = 10.0,
-        trace_threshold_ms: float = 100.0,
+        trace_threshold_ms: float | None = None,
         tracer=None,
     ):
         self.store = store
         self.metrics = metrics or SchedulerMetrics()
+        #: OTel-style spans (§5.1); same default process tracer as the
+        #: apiserver so one tracer assembles the whole pod journey.
+        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
+        self.tracer = tracer if tracer is not None else DEFAULT_TRACER
         if profiles is None:
             plugins = build_plugins(store=store)
             fwk = Framework(plugins, DEFAULT_SCORE_WEIGHTS, metrics=self.metrics)
@@ -101,6 +107,8 @@ class Scheduler:
         for fwk in self.profiles.values():
             if fwk.metrics is None:
                 fwk.metrics = self.metrics
+            if getattr(fwk, "tracer", None) is None:
+                fwk.tracer = self.tracer
             for p in fwk.post_filter_plugins:
                 if isinstance(p, DefaultPreemption):
                     p.framework = fwk
@@ -118,13 +126,14 @@ class Scheduler:
             max_backoff=pod_max_backoff)
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         #: utiltrace threshold: scheduling attempts slower than this log a
-        #: step-by-step latency trace (SURVEY §5.1).
+        #: step-by-step latency trace (SURVEY §5.1). None defaults from
+        #: KTPU_TRACE_THRESHOLD_MS (the tracer's tree-dump threshold
+        #: reads the same variable), else the reference's 100ms.
+        if trace_threshold_ms is None:
+            trace_threshold_ms = float(
+                os.environ.get("KTPU_TRACE_THRESHOLD_MS") or 100.0)
         self.trace_threshold_ms = trace_threshold_ms
         self.rng = random.Random(seed)
-        #: OTel-style spans (§5.1); same default process tracer as the
-        #: apiserver so one tracer assembles the whole pod journey.
-        from kubernetes_tpu.utils.tracing import DEFAULT_TRACER
-        self.tracer = tracer if tracer is not None else DEFAULT_TRACER
         self.backend = None  # TPU batch backend; None = host path
         if backend is not None:
             self.attach_backend(backend)
@@ -270,11 +279,13 @@ class Scheduler:
 
     def attach_backend(self, backend) -> None:
         """Attach the batched backend — the ONE place its cross-wiring
-        (degradation metrics, §5.5) happens, for both constructor
-        injection and config-built schedulers."""
+        (degradation metrics + tracer, §5.5/§5.1) happens, for both
+        constructor injection and config-built schedulers."""
         self.backend = backend
         if backend is not None and hasattr(backend, "metrics"):
             backend.metrics = self.metrics
+        if backend is not None and hasattr(backend, "tracer"):
+            backend.tracer = self.tracer
 
     def _responsible(self, pi: PodInfo) -> bool:
         return pi.scheduler_name in self.profiles
@@ -321,15 +332,18 @@ class Scheduler:
         # Round-robin start offset mirrors nextStartNodeIndex fairness.
         start = self.rng.randrange(len(snapshot)) if len(snapshot) else 0
         nodes = snapshot.nodes
-        for i in range(len(nodes)):
-            node = nodes[(start + i) % len(nodes)]
-            st = fwk.run_filters(state, pod, node)
-            if st.is_success():
-                feasible.append(node)
-                if len(feasible) >= want:
-                    break
-            else:
-                statuses[node.name] = st
+        # One Filter span over the whole node scan (per-node spans would
+        # be N per attempt); run_filters keeps its per-plugin metrics.
+        with fwk.ep_span("Filter"):
+            for i in range(len(nodes)):
+                node = nodes[(start + i) % len(nodes)]
+                st = fwk.run_filters(state, pod, node)
+                if st.is_success():
+                    feasible.append(node)
+                    if len(feasible) >= want:
+                        break
+                else:
+                    statuses[node.name] = st
         # findNodesThatPassExtenders: HTTP webhooks narrow the feasible set.
         for ext in self.extenders:
             if not feasible:
@@ -555,6 +569,26 @@ class Scheduler:
             return
         fwk = self.profiles.get(pods[0].scheduler_name) or next(iter(self.profiles.values()))
         t0 = time.perf_counter()
+        if self.tracer.enabled:
+            # One attempt span per backend batch: the device solve is a
+            # joint decision over the whole batch, so per-pod spans would
+            # invent a serialization that never happened. A single-pod
+            # batch parents to its create request (stamped traceparent)
+            # and carries the pod key for trace_for joins.
+            attrs = {"pods": len(pods), "profile": fwk.profile_name}
+            tp = None
+            if len(pods) == 1:
+                attrs["pod"] = pods[0].key
+                tp = traceparent_of(pods[0].pod)
+            with self.tracer.span("scheduler.attempt", traceparent=tp,
+                                  **attrs):
+                for pi in pods:
+                    self._record_queue_wait(pi)
+                return await self._backend_cycle(pods, snapshot, fwk, t0)
+        await self._backend_cycle(pods, snapshot, fwk, t0)
+
+    async def _backend_cycle(self, pods: list[PodInfo], snapshot, fwk,
+                             t0: float) -> None:
         try:
             if hasattr(self.backend, "assign_stream"):
                 # Chunk-streaming path: bindings for chunk k start while
@@ -701,6 +735,19 @@ class Scheduler:
                         "failure handling errored for %s", pi.key)
                     await self.queue.move_to_backoff(pi)
 
+    def _record_queue_wait(self, pi: PodInfo) -> None:
+        """Retroactive queue-wait child span: the informer→queue→cycle
+        hop crosses tasks no context can follow, so the span is rebuilt
+        from the queue's own timestamps (same monotonic clock).
+        enqueued_at is re-stamped per activeQ entry, so a retried pod's
+        span covers only THIS attempt's wait — not earlier cycles or
+        backoff windows."""
+        start = pi.enqueued_at or pi.queued_at
+        if start and pi.dequeued_at >= start > 0.0:
+            self.tracer.record("scheduler.queue.wait", start,
+                               pi.dequeued_at, pod=pi.key,
+                               attempts=pi.attempts)
+
     async def _schedule_host_path(self, pi: PodInfo, snapshot) -> None:
         fwk = self.profiles.get(pi.scheduler_name)
         if fwk is None:
@@ -708,8 +755,12 @@ class Scheduler:
             await self.queue.done(pi.key)
             return
         if self.tracer.enabled:
+            # traceparent stamped by the creating request (any wire)
+            # parents this attempt into the pod's create trace.
             with self.tracer.span("scheduler.attempt", pod=pi.key,
-                                  profile=fwk.profile_name):
+                                  profile=fwk.profile_name,
+                                  traceparent=traceparent_of(pi.pod)):
+                self._record_queue_wait(pi)
                 return await self._schedule_host_path_traced(
                     pi, snapshot, fwk)
         await self._schedule_host_path_traced(pi, snapshot, fwk)

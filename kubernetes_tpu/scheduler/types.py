@@ -87,7 +87,7 @@ class PodInfo:
         "required_affinity_terms", "required_anti_affinity_terms",
         "preferred_affinity_terms", "preferred_anti_affinity_terms",
         "attempts", "last_failure", "unschedulable_plugins", "queued_at",
-        "nominated_node",
+        "enqueued_at", "dequeued_at", "nominated_node",
     )
 
     def __init__(self, pod: Mapping):
@@ -131,6 +131,12 @@ class PodInfo:
         self.last_failure = ""
         self.unschedulable_plugins: set[str] = set()
         self.queued_at = 0.0
+        #: endpoints of the retroactive queue-wait span, same clock as
+        #: queued_at: enqueued_at is re-stamped on every activeQ entry
+        #: (so a retry's span covers only THIS attempt's wait, not prior
+        #: cycles/backoff), dequeued_at when pop_batch hands it out.
+        self.enqueued_at = 0.0
+        self.dequeued_at = 0.0
         self.nominated_node = ""
 
     @property

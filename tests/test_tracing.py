@@ -1,0 +1,424 @@
+"""End-to-end attempt tracing (SURVEY §5.1): traceparent propagation
+across all three wires, threshold-triggered span-tree dumps, and the
+Chrome/Perfetto export nesting device-solve chunks under the attempt.
+"""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.utils.tracing import (
+    DEFAULT_TRACER,
+    TRACEPARENT_ANNOTATION,
+    Tracer,
+    traceparent_of,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def tracer():
+    DEFAULT_TRACER.enabled = True
+    DEFAULT_TRACER.clear()
+    yield DEFAULT_TRACER
+    DEFAULT_TRACER.enabled = False
+    DEFAULT_TRACER.clear()
+
+
+def _span(tracer, name):
+    matches = [s for s in tracer.spans if s.name == name]
+    assert matches, ([s.name for s in tracer.spans], name)
+    return matches[-1]
+
+
+class TestTraceparentPropagation:
+    """(a) one traceparent survives each wire's round-trip: the server's
+    request span joins the client's trace instead of opening a new one."""
+
+    def test_http_roundtrip(self, tracer):
+        async def body():
+            from kubernetes_tpu.apiserver import APIServer, RemoteStore
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            srv = APIServer(backing)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            try:
+                with tracer.span("client.create") as root:
+                    created = await rs.create("pods", make_pod("p-http"))
+            finally:
+                await rs.close()
+                await srv.stop()
+                backing.stop()
+            server_span = _span(tracer, "apiserver.create.pods")
+            assert server_span.trace_id == root.trace_id
+            assert server_span.parent_id == root.span_id
+            # the stored pod carries the request's traceparent for the
+            # scheduler to parent to (same trace id)
+            tp = traceparent_of(created)
+            assert tp and root.trace_id in tp
+        run(body())
+
+    def test_wire_roundtrip(self, tracer):
+        async def body():
+            from kubernetes_tpu.apiserver import APIServer
+            from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            api = APIServer(backing)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            ws = WireStore(wire.target)
+            try:
+                with tracer.span("client.create") as root:
+                    created = await ws.create("pods", make_pod("p-wire"))
+            finally:
+                await ws.close()
+                await wire.stop()
+                await api.stop()
+                backing.stop()
+            server_span = _span(tracer, "wire.create.pods")
+            assert server_span.trace_id == root.trace_id
+            assert server_span.parent_id == root.span_id
+            tp = traceparent_of(created)
+            assert tp and root.trace_id in tp
+        run(body())
+
+    def test_wire_multi_members_each_join_the_trace(self, tracer):
+        """Ops coalesced into one multi frame are still N requests: each
+        member's server span parents to ITS caller's span."""
+        async def body():
+            from kubernetes_tpu.apiserver import APIServer
+            from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            api = APIServer(backing)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            ws = WireStore(wire.target)
+            try:
+                await ws.create("nodes", make_node("warm"))  # connect
+                with tracer.span("client.batch") as root:
+                    # same-tick gather coalesces into one multi frame
+                    await asyncio.gather(
+                        ws.create("pods", make_pod("m-0")),
+                        ws.create("pods", make_pod("m-1")))
+            finally:
+                await ws.close()
+                await wire.stop()
+                await api.stop()
+                backing.stop()
+            members = [s for s in tracer.spans
+                       if s.name == "wire.create.pods"
+                       and s.trace_id == root.trace_id]
+            assert len(members) == 2, [
+                (s.name, s.trace_id) for s in tracer.spans]
+        run(body())
+
+    def test_malformed_traced_frame_still_gets_a_reply(self, tracer):
+        """A traced wrapper carrying a non-string traceparent must
+        degrade to an untraced op, not crash span creation outside the
+        error-reply path (which would hang the caller's future)."""
+        async def body():
+            from kubernetes_tpu.apiserver import APIServer
+            from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+            from kubernetes_tpu.store.mvcc import NotFound
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            api = APIServer(backing)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            ws = WireStore(wire.target)
+            try:
+                await ws.create("nodes", make_node("warm"))  # connect
+                fut = asyncio.get_event_loop().create_future()
+                ws._pending["rx"] = fut
+                ws._send(["rx", "traced", 123, "get", "pods",
+                          "default/missing"])
+                with pytest.raises(NotFound):  # a real reply, not a hang
+                    await asyncio.wait_for(fut, 5.0)
+            finally:
+                await ws.close()
+                await wire.stop()
+                await api.stop()
+                backing.stop()
+        run(body())
+
+    def test_grpc_roundtrip(self, tracer):
+        async def body():
+            from kubernetes_tpu.apiserver.grpc_server import (
+                GRPCAPIServer,
+                GRPCRemoteStore,
+            )
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            srv = GRPCAPIServer(backing)
+            await srv.start()
+            client = GRPCRemoteStore(srv.target)
+            try:
+                with tracer.span("client.create") as root:
+                    created = await client.create(
+                        "pods", make_pod("p-grpc"))
+            finally:
+                await client.close()
+                await srv.stop()
+                backing.stop()
+            server_span = _span(tracer, "grpc.create.pods")
+            assert server_span.trace_id == root.trace_id
+            assert server_span.parent_id == root.span_id
+            tp = traceparent_of(created)
+            assert tp and root.trace_id in tp
+        run(body())
+
+    def test_wire_create_parents_scheduler_attempt(self, tracer):
+        """The full journey: a create through the KTPU wire parents the
+        scheduler's attempt span (via the stamped annotation), which in
+        turn holds the queue-wait and extension-point children; the wire
+        span is joinable by audit ID."""
+        async def body():
+            from kubernetes_tpu.apiserver import APIServer
+            from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+            from kubernetes_tpu.client import InformerFactory
+            from kubernetes_tpu.policy import AuditPipeline, AuditPolicy
+            from kubernetes_tpu.scheduler import Scheduler
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            audit = AuditPipeline(AuditPolicy.metadata_for_all())
+            api = APIServer(backing, audit=audit)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            ws = WireStore(wire.target)
+            sched = Scheduler(ws, seed=3)
+            factory = InformerFactory(ws)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            run_task = asyncio.ensure_future(sched.run(batch_size=1))
+            try:
+                await ws.create("nodes", make_node("n0"))
+                with tracer.span("kubectl.create") as root:
+                    await ws.create("pods", make_pod("journey"))
+                for _ in range(300):
+                    p = await ws.get("pods", "default/journey")
+                    if p["spec"].get("nodeName"):
+                        break
+                    await asyncio.sleep(0.02)
+                assert p["spec"].get("nodeName") == "n0"
+            finally:
+                await sched.stop()
+                run_task.cancel()
+                factory.stop()
+                await ws.close()
+                await wire.stop()
+                await api.stop()
+                await audit.close()
+                backing.stop()
+            wire_span = next(
+                s for s in tracer.spans if s.name == "wire.create.pods"
+                and s.trace_id == root.trace_id)
+            attempt = next(
+                s for s in tracer.spans if s.name == "scheduler.attempt"
+                and s.attrs.get("pod") == "default/journey")
+            # ONE trace: client span → wire request span → attempt span
+            assert attempt.trace_id == root.trace_id
+            assert attempt.parent_id == wire_span.span_id
+            # queue wait + extension points nest under the attempt
+            kids = {s.name for s in tracer.spans
+                    if s.parent_id == attempt.span_id}
+            assert "scheduler.queue.wait" in kids, kids
+            assert "framework.PreFilter" in kids, kids
+            assert "framework.Filter" in kids, kids
+            # audit ↔ trace join: the wire span carries the auditID and
+            # the audit event carries the span's traceparent
+            audit_id = wire_span.attrs.get("audit_id")
+            assert audit_id
+            entry = next(e for e in audit.sink.entries
+                         if e["auditID"] == audit_id
+                         and e["stage"] == "ResponseComplete")
+            assert wire_span.trace_id in \
+                entry["annotations"]["traceparent"]
+        run(body())
+
+
+class TestThresholdTreeDump:
+    """(b) utiltrace semantics for span trees: only roots slower than the
+    threshold log their breakdown."""
+
+    def test_fires_above_threshold(self, caplog):
+        t = Tracer(enabled=True, threshold_ms=0.0)
+        with caplog.at_level(logging.INFO,
+                             logger="kubernetes_tpu.utils.tracing"):
+            with t.span("attempt", pod="default/p"):
+                with t.span("solve"):
+                    pass
+        assert len(caplog.records) == 1
+        msg = caplog.records[0].message
+        assert "Span[attempt{pod=default/p}]" in msg
+        assert "solve" in msg
+
+    def test_silent_below_threshold(self, caplog):
+        t = Tracer(enabled=True, threshold_ms=10_000.0)
+        with caplog.at_level(logging.INFO,
+                             logger="kubernetes_tpu.utils.tracing"):
+            with t.span("attempt"):
+                with t.span("solve"):
+                    pass
+        assert not caplog.records
+
+    def test_child_spans_never_dump(self, caplog):
+        """Only ROOTS trigger the dump — a slow child logs once via its
+        root, not once per nesting level."""
+        t = Tracer(enabled=True, threshold_ms=0.0)
+        with caplog.at_level(logging.INFO,
+                             logger="kubernetes_tpu.utils.tracing"):
+            with t.span("root"):
+                with t.span("mid"):
+                    with t.span("leaf"):
+                        pass
+        assert len(caplog.records) == 1
+
+    def test_env_var_default(self, monkeypatch):
+        monkeypatch.setenv("KTPU_TRACE_THRESHOLD_MS", "250")
+        assert Tracer().threshold_ms == 250.0
+        monkeypatch.delenv("KTPU_TRACE_THRESHOLD_MS")
+        assert Tracer().threshold_ms is None
+
+
+class TestPerfettoExport:
+    """(c) schema-valid Chrome trace JSON with device-solve chunks nested
+    under the scheduling attempt."""
+
+    def test_solve_spans_nest_under_attempt(self, tracer):
+        async def body():
+            from kubernetes_tpu.client import InformerFactory
+            from kubernetes_tpu.ops import TPUBackend
+            from kubernetes_tpu.scheduler import Scheduler
+            store = new_cluster_store()
+            install_core_validation(store)
+            for i in range(2):
+                await store.create("nodes", make_node(f"n{i}"))
+            # Pods staged BEFORE the loop starts so one pop drains a
+            # multi-pod batch through the device backend.
+            for i in range(4):
+                await store.create("pods", make_pod(f"p{i}"))
+            sched = Scheduler(store, seed=7,
+                              backend=TPUBackend(max_batch=8))
+            factory = InformerFactory(store)
+            await sched.setup_informers(factory)
+            factory.start()
+            await factory.wait_for_sync()
+            run_task = asyncio.ensure_future(sched.run(batch_size=8))
+            try:
+                for _ in range(600):
+                    pods = (await store.list("pods")).items
+                    if sum(1 for p in pods
+                           if p["spec"].get("nodeName")) == 4:
+                        break
+                    await asyncio.sleep(0.02)
+                assert sum(1 for p in pods
+                           if p["spec"].get("nodeName")) == 4
+            finally:
+                await sched.stop()
+                run_task.cancel()
+                factory.stop()
+                store.stop()
+
+            doc = json.loads(tracer.to_perfetto())
+            evs = doc["traceEvents"]
+            assert evs
+            for e in evs:  # Chrome trace-event schema (complete events)
+                assert e["ph"] == "X"
+                for field in ("name", "pid", "tid", "ts", "dur", "args"):
+                    assert field in e, (field, e)
+            by_span = {e["args"]["span_id"]: e for e in evs}
+            solve = next(e for e in evs if e["name"] == "solver.solve")
+            # walk the parent chain: the solve chunk must nest under a
+            # scheduler.attempt span
+            seen = set()
+            cur = solve
+            while cur is not None and cur["name"] != "scheduler.attempt":
+                pid = cur["args"].get("parent_id")
+                assert pid and pid not in seen, \
+                    (solve, [e["name"] for e in evs])
+                seen.add(pid)
+                cur = by_span.get(pid)
+            assert cur is not None and cur["name"] == "scheduler.attempt"
+            # dispatch span rides the same tree
+            assert any(e["name"] == "solver.dispatch" for e in evs)
+            # binds happened and are attributed to pods for trace_for
+            assert any(e["name"] == "scheduler.bind" for e in evs)
+        run(body())
+
+    def test_queue_wait_covers_only_current_attempt(self, tracer):
+        """A retried pod's queue.wait span starts at its LATEST activeQ
+        entry, not first-enqueue — prior cycles and backoff windows must
+        not inflate the wait."""
+        async def body():
+            from kubernetes_tpu.scheduler.framework import Framework
+            from kubernetes_tpu.scheduler.queue import SchedulingQueue
+            from kubernetes_tpu.scheduler.types import PodInfo
+            now = [100.0]
+            q = SchedulingQueue(Framework([]), initial_backoff=0.0,
+                                clock=lambda: now[0])
+            pi = PodInfo(make_pod("retry"))
+            await q.add(pi)
+            assert pi.enqueued_at == 100.0
+            now[0] = 101.0
+            (popped,) = await q.pop_batch(1)
+            assert popped.dequeued_at == 101.0
+            now[0] = 150.0  # a long failed cycle...
+            await q.move_to_backoff(pi)
+            async with q._cond:
+                q._flush_backoff_locked()  # ...then re-activation
+            assert pi.enqueued_at == 150.0  # re-stamped, not 100.0
+            now[0] = 150.5
+            (popped,) = await q.pop_batch(1)
+            assert popped.dequeued_at - popped.enqueued_at == 0.5
+            await q.close()
+        run(body())
+
+    def test_retroactive_record_parents_to_current(self, tracer):
+        with tracer.span("attempt") as sp:
+            tracer.record("queue.wait", 1.0, 2.0, pod="default/x")
+        rec = _span(tracer, "queue.wait")
+        assert rec.parent_id == sp.span_id
+        assert rec.trace_id == sp.trace_id
+        assert abs(rec.duration_ms - 1000.0) < 1e-6
+        doc = json.loads(tracer.to_perfetto())
+        assert any(e["name"] == "queue.wait" for e in doc["traceEvents"])
+
+
+class TestDisabledOverhead:
+    """Tracing off (the default) must leave no trace artifacts anywhere
+    on the path — the <2% bench headline guard's functional half."""
+
+    def test_no_annotation_stamped_when_disabled(self):
+        async def body():
+            from kubernetes_tpu.apiserver import APIServer, RemoteStore
+            backing = new_cluster_store()
+            install_core_validation(backing)
+            srv = APIServer(backing)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            try:
+                created = await rs.create("pods", make_pod("plain"))
+            finally:
+                await rs.close()
+                await srv.stop()
+                backing.stop()
+            ann = (created["metadata"].get("annotations") or {})
+            assert TRACEPARENT_ANNOTATION not in ann
+            assert len(DEFAULT_TRACER.spans) == 0
+        assert not DEFAULT_TRACER.enabled
+        run(body())
