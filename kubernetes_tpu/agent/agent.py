@@ -75,6 +75,7 @@ class NodeAgent:
                          f"devices-{node_name}.checkpoint.json"),
             node_name)
         self._tasks: list[asyncio.Task] = []
+        self._watch_task: asyncio.Task | None = None
         self._workers: set[asyncio.Task] = set()
         #: pod key -> latest observed object (None = deleted); per-pod
         #: workers drain this map serially per key, latest state wins
@@ -114,8 +115,9 @@ class NodeAgent:
             self._ip_seq = max(self._ip_seq, self._ip_seq_of(p))
         for p in lst.items:
             self._observe(namespaced_name(p), p)
-        self._tasks.append(asyncio.ensure_future(
-            self._watch_loop(lst.resource_version)))
+        self._watch_task = asyncio.ensure_future(
+            self._watch_loop(lst.resource_version))
+        self._tasks.append(self._watch_task)
         self._tasks.append(asyncio.ensure_future(self._lease_loop()))
 
     async def stop(self) -> None:
@@ -162,8 +164,13 @@ class NodeAgent:
     # -- watch loop (syncLoop's config source) -----------------------------
 
     async def _watch_loop(self, from_rv: int) -> None:
-        """The kubelet's apiserver config source: a field-filtered watch;
-        on expiry/disconnect, relist and resume (reflector contract)."""
+        """The kubelet's apiserver config source: a field-filtered watch.
+        On disconnect, resume the watch from the last bookmark/event RV —
+        the apiserver's watch cache backfills the gap from its ring — and
+        fall back to the full relist ONLY on Expired (410: the server
+        says the gap is gone). N agents reconnecting after a blip thus
+        cost N ring backfills, not N store LISTs (reflector contract +
+        bookmark-driven resync)."""
         rv = from_rv
         fields = {"spec.nodeName": self.node_name}
         while not self._stopped:
@@ -180,27 +187,74 @@ class NodeAgent:
                         key, None if ev.type == "DELETED" else ev.object)
             except asyncio.CancelledError:
                 raise
-            except (Expired, StoreError):
+            except Expired:
                 if self._stopped:
                     return
-                try:
-                    lst = await self.store.list("pods", fields=fields)
-                except Exception:
-                    await asyncio.sleep(0.5)
-                    continue
-                rv = lst.resource_version
-                seen = set()
-                for p in lst.items:
-                    key = namespaced_name(p)
-                    seen.add(key)
-                    self._observe(key, p)
-                # Pods that vanished while the watch was down.
-                for key in self.ledger.reconcile(seen):
-                    self._observe(key, None)
+                new_rv = await self._relist(fields)
+                if new_rv is not None:
+                    rv = new_rv
+            except StoreError:
+                if self._stopped:
+                    return
+                # Transport error: the RV is (probably) still servable —
+                # resume from it instead of amplifying into a LIST storm.
+                # (A server that restarted with a reset RV counter makes
+                # the resume Expired — the relist branch above — so this
+                # cannot strand the agent on a stale RV.)
+                await asyncio.sleep(0.5)
             except Exception:
                 logger.exception("agent %s: watch loop error",
                                  self.node_name)
                 await asyncio.sleep(0.5)
+
+    async def _relist(self, fields: dict) -> int | None:
+        """Full LIST + ledger reconcile (the 410/cold-start path).
+        Returns the LIST's RV, or None if the LIST failed (after a
+        backoff sleep) — callers retry or keep their RV."""
+        try:
+            lst = await self.store.list("pods", fields=fields)
+        except Exception:
+            await asyncio.sleep(0.5)
+            return None
+        seen = set()
+        for p in lst.items:
+            key = namespaced_name(p)
+            seen.add(key)
+            self._observe(key, p)
+        # Pods that vanished while the watch was down.
+        for key in self.ledger.reconcile(seen):
+            self._observe(key, None)
+        return lst.resource_version
+
+    async def force_relist(self) -> None:
+        """Cold-start reconnect, forced: tear down the watch, full LIST +
+        reconcile, re-watch from the LIST's RV. The relist-storm
+        scenario's per-agent unit (perf/scheduler_perf.py `relistStorm`
+        gathers this across every agent at once) — with the watch cache
+        active the LIST is a read of the shared snapshot, so the storm
+        costs the store one table seed total, not one scan per agent."""
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            try:
+                await self._watch_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            try:
+                self._tasks.remove(self._watch_task)
+            except ValueError:
+                pass
+            self._watch_task = None
+        fields = {"spec.nodeName": self.node_name}
+        # The relist must land before re-watching: watch-from-now with
+        # no reconcile would never observe pods deleted while the watch
+        # was down (the ledger would hold their devices forever).
+        rv = None
+        while rv is None and not self._stopped:
+            rv = await self._relist(fields)
+        if self._stopped:
+            return
+        self._watch_task = asyncio.ensure_future(self._watch_loop(rv))
+        self._tasks.append(self._watch_task)
 
     # -- pod workers -------------------------------------------------------
 

@@ -283,6 +283,10 @@ class RemoteStore:
         selector: Selector | None = None, limit: int = 0,
         continue_key: str | None = None,
         fields: Mapping[str, str] | None = None,
+        *,
+        resource_version: int | None = None,
+        resource_version_match: str | None = None,
+        **_kw,
     ) -> ListResult:
         params = {}
         sel = selector_to_string(selector)
@@ -295,6 +299,12 @@ class RemoteStore:
             params["limit"] = str(limit)
         if continue_key:
             params["continue"] = continue_key
+        if resource_version:
+            # Watch-cache RV semantics (store/cacher.py): Exact pins the
+            # historical snapshot; bare RV = "not older than" = current.
+            params["resourceVersion"] = str(resource_version)
+            if resource_version_match:
+                params["resourceVersionMatch"] = resource_version_match
         async with self._sess().get(
                 self._collection_url(resource, namespace),
                 params=params) as resp:
@@ -302,7 +312,8 @@ class RemoteStore:
         return ListResult(
             items=body.get("items", []),
             resource_version=int(
-                body.get("metadata", {}).get("resourceVersion", 0)))
+                body.get("metadata", {}).get("resourceVersion", 0)),
+            cont=body.get("metadata", {}).get("continue"))
 
     async def watch(
         self, resource: str, resource_version: int = 0,

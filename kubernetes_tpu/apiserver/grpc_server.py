@@ -112,11 +112,17 @@ class StoreService:
         sel = parse_selector(request.label_selector) \
             if request.label_selector else None
         try:
+            # Served from the watch-cache tier (store/cacher.py) like the
+            # other two wires. Exact-RV reads need no new proto field:
+            # the continue token carries its own RV pin ("<rv>:<key>",
+            # "<rv>:" for a pinned first page), so snapshot-consistent
+            # pagination round-trips through ListRequest.continue_key.
             lst = await self.store.list(
                 request.resource,
                 namespace=request.namespace or None,
                 selector=sel, limit=request.limit,
-                continue_key=request.continue_key or None)
+                continue_key=request.continue_key or None,
+                copy=False)  # encode-only: wrapped before return
         except StoreError as e:
             await context.abort(_abort_code(e), str(e))
         return ktpu_pb2.ListResponse(
@@ -175,7 +181,8 @@ class StoreService:
             async for ev in await self.store.watch(
                     request.resource, resource_version=rv, selector=sel):
                 yield ktpu_pb2.WatchEvent(
-                    type=ev.type, object=_wrap(ev.object))
+                    type=ev.type, object=_wrap(ev.object),
+                    rv=str(ev.rv))
         except Expired as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         except StoreError as e:
@@ -471,19 +478,21 @@ class GRPCAPIServer:
 
 
 class _ListResult:
-    __slots__ = ("items", "resource_version")
+    __slots__ = ("items", "resource_version", "cont")
 
-    def __init__(self, items, rv):
+    def __init__(self, items, rv, cont=None):
         self.items = items
         self.resource_version = rv
+        self.cont = cont
 
 
 class _Event:
-    __slots__ = ("type", "object")
+    __slots__ = ("type", "object", "rv")
 
-    def __init__(self, type_, obj):
+    def __init__(self, type_, obj, rv=0):
         self.type = type_
         self.object = obj
+        self.rv = rv
 
 
 def _map_rpc_error(e: grpc.aio.AioRpcError) -> StoreError:
@@ -537,8 +546,17 @@ class GRPCRemoteStore:
 
     async def list(self, resource: str, namespace: str | None = None,
                    selector: Selector | None = None, limit: int = 0,
-                   continue_key: str | None = None) -> _ListResult:
+                   continue_key: str | None = None, *,
+                   resource_version: int | None = None,
+                   resource_version_match: str | None = None,
+                   **_kw) -> _ListResult:
         sel = selector_to_string(selector) if selector else ""
+        if resource_version and resource_version_match == "Exact" \
+                and not continue_key:
+            # Exact-RV LIST without a proto field: the pinned continue
+            # token ("<rv>:") asks the server's watch-cache tier for the
+            # snapshot at that RV from the first page on.
+            continue_key = f"{resource_version}:"
         try:
             resp = await self._uu(
                 "List",
@@ -549,8 +567,17 @@ class GRPCRemoteStore:
                 ktpu_pb2.ListResponse)
         except grpc.aio.AioRpcError as e:
             raise _map_rpc_error(e) from e
-        return _ListResult([_unwrap(u) for u in resp.items],
-                           int(resp.resource_version))
+        items = [_unwrap(u) for u in resp.items]
+        cont = None
+        if limit and len(items) >= limit and items:
+            # ListResponse carries no token field; rebuild the pinned one
+            # from the snapshot RV + last key (the server resumes
+            # strictly after it, at the same snapshot).
+            meta = items[-1].get("metadata") or {}
+            last = f"{meta['namespace']}/{meta['name']}" \
+                if meta.get("namespace") else meta.get("name", "")
+            cont = f"{int(resp.resource_version)}:{last}"
+        return _ListResult(items, int(resp.resource_version), cont)
 
     async def create(self, resource: str, obj: dict, **_kw) -> dict:
         try:
@@ -620,7 +647,13 @@ class GRPCRemoteStore:
         async def gen():
             try:
                 async for ev in call:
-                    yield _Event(ev.type, _unwrap(ev.object))
+                    obj = _unwrap(ev.object)
+                    # rv rides its own field; old servers omit it, so
+                    # fall back to the object's stamped metadata.
+                    rv = int(ev.rv) if ev.rv else int(
+                        (obj.get("metadata") or {})
+                        .get("resourceVersion") or 0)
+                    yield _Event(ev.type, obj, rv)
             except grpc.aio.AioRpcError as e:
                 raise _map_rpc_error(e) from e
             except asyncio.CancelledError:

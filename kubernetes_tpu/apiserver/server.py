@@ -253,6 +253,9 @@ class APIServer:
             # Watch-dispatch counters live on the store (it owns dispatch);
             # surface them through this server's /metrics exposition.
             store.watch_metrics.register_into(metrics_registry)
+            if store.cacher is not None:
+                # Watch-cache serving-tier counters (hits/misses/ring).
+                store.cacher.metrics.register_into(metrics_registry)
             self.request_metrics.register_into(metrics_registry)
             if audit is not None:
                 audit.register_into(metrics_registry)
@@ -790,17 +793,32 @@ class APIServer:
                     request.query["fieldSelector"])
             limit = int(request.query.get("limit", 0) or 0)
             cont = request.query.get("continue")
+            # RV-semantics params (the cacher contract, store/cacher.py):
+            # resourceVersion + resourceVersionMatch=Exact serves the
+            # historical snapshot; bare/0 RVs serve "any cached" =
+            # current. Continue tokens carry their own RV pin.
+            rv_q = request.query.get("resourceVersion")
+            rv = int(rv_q) if rv_q and rv_q.isdigit() and int(rv_q) \
+                else None
             lst = await self.store.list(
                 resource, namespace=request["namespace"], selector=sel,
-                limit=limit, continue_key=cont, fields=fields)
+                limit=limit, continue_key=cont, fields=fields,
+                resource_version=rv,
+                resource_version_match=request.query.get(
+                    "resourceVersionMatch"),
+                copy=False)  # encode-only: serialized before return
             body = {
                 "kind": "List", "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(lst.resource_version)},
                 "items": lst.items,
             }
-            if limit and len(lst.items) >= limit:
-                # etcd-style continue token: the store key of the last item
-                # (store.list resumes strictly after continue_key).
+            if lst.cont:
+                # Snapshot-pinned token off the cacher: later pages are
+                # served at THIS page's RV (identical on the KTPU wire).
+                body["metadata"]["continue"] = lst.cont
+            elif limit and len(lst.items) >= limit:
+                # Legacy (cacher disabled): the bare store key of the
+                # last item (store.list resumes strictly after it).
                 last = lst.items[-1]["metadata"]
                 ns = last.get("namespace")
                 body["metadata"]["continue"] = \

@@ -648,12 +648,22 @@ class _Conn(asyncio.Protocol):
             resource, args = frame[2], frame[3] or {}
             sel = parse_selector(args["selector"]) \
                 if args.get("selector") else None
+            # RV semantics + snapshot-pinned continue tokens ride the
+            # watch-cache tier (store/cacher.py) — same contract as the
+            # HTTP wire's resourceVersion/resourceVersionMatch params,
+            # so paginated pages agree on one snapshot RV across wires.
             lst = await store.list(
                 resource, namespace=args.get("namespace"),
                 selector=sel, limit=int(args.get("limit") or 0),
                 continue_key=args.get("continue"),
-                fields=args.get("fields") or None)
-            return {"items": lst.items, "rv": lst.resource_version}
+                fields=args.get("fields") or None,
+                resource_version=int(args.get("rv") or 0) or None,
+                resource_version_match=args.get("rvMatch"),
+                copy=False)  # encode-only: packed before return
+            out = {"items": lst.items, "rv": lst.resource_version}
+            if lst.cont:
+                out["cont"] = lst.cont
+            return out
         if op == "kinds":
             return {"kinds": store.kind_map(),
                     "clusterScoped": sorted(
@@ -1181,14 +1191,23 @@ class WireStore:
         selector: Selector | None = None, limit: int = 0,
         continue_key: str | None = None,
         fields: Mapping[str, str] | None = None,
+        *,
+        resource_version: int | None = None,
+        resource_version_match: str | None = None,
+        **_kw,
     ) -> ListResult:
-        resp = await self._call("list", resource, {
+        args = {
             "namespace": namespace,
             "selector": selector_to_string(selector) or None,
             "limit": limit or 0, "continue": continue_key,
-            "fields": dict(fields) if fields else None})
+            "fields": dict(fields) if fields else None}
+        if resource_version:
+            args["rv"] = resource_version
+            args["rvMatch"] = resource_version_match
+        resp = await self._call("list", resource, args)
         return ListResult(items=resp["items"],
-                          resource_version=int(resp["rv"]))
+                          resource_version=int(resp["rv"]),
+                          cont=resp.get("cont"))
 
     async def watch(
         self, resource: str, resource_version: int = 0,

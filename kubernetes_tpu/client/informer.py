@@ -175,13 +175,23 @@ class SharedInformer:
             self._task = None
 
     async def _run(self) -> None:
-        """Reflector.ListAndWatch with relist-on-410."""
+        """Reflector.ListAndWatch with relist-on-410 and bookmark-driven
+        resume: a watch error that is NOT a 410 re-watches from the last
+        bookmark/event RV instead of unconditionally relisting — the
+        watch cache's ring replays the gap, so a transport hiccup across
+        N informers costs N backfills of a shared ring, not N store
+        LISTs (the client half of the relist-storm fix). Only Expired —
+        the server saying the gap is unservable — forces the full LIST."""
+        relist = True
         while True:
             try:
-                lst = await self.store.list(self.resource, selector=self.selector)
-                self._replace(lst.items)
-                self.last_rv = lst.resource_version
-                self._synced.set()
+                if relist or not self.last_rv:
+                    lst = await self.store.list(
+                        self.resource, selector=self.selector)
+                    self._replace(lst.items)
+                    self.last_rv = lst.resource_version
+                    self._synced.set()
+                    relist = False
                 watch = await self.store.watch(
                     self.resource, resource_version=self.last_rv,
                     selector=self.selector,
@@ -194,11 +204,14 @@ class SharedInformer:
                     self.last_rv = ev.rv
             except Expired:
                 logger.info("informer %s: watch expired, relisting", self.resource)
+                relist = True
                 continue
             except asyncio.CancelledError:
                 return
             except Exception:
-                logger.exception("informer %s: reflector error, retrying", self.resource)
+                logger.exception(
+                    "informer %s: reflector error, resuming from rv %d",
+                    self.resource, self.last_rv)
                 await asyncio.sleep(0.2)
 
     def _replace(self, objs: list[dict]) -> None:

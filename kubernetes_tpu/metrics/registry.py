@@ -76,6 +76,12 @@ class Gauge(Counter):
         with self._lock:
             self._values[key] = value
 
+    def set_key(self, key: tuple, value: float) -> None:
+        """Hot-path set with a caller-cached label tuple (the Counter
+        inc_key idiom; the watch cache sets ring length per event)."""
+        with self._lock:
+            self._values[key] = value
+
     def render(self) -> str:
         return self._render("gauge")
 
@@ -285,6 +291,40 @@ class WatchMetrics:
         for c in (self.events_dispatched, self.predicate_checks,
                   self.index_hits):
             registry._metrics.setdefault(c.name, c)
+
+
+class WatchCacheMetrics:
+    """Watch-cache serving-tier counters (the reference's
+    `apiserver_watch_cache_*` / `apiserver_cache_list_*` families,
+    SURVEY §L0): hits are LIST/watch-establishment requests answered
+    from the RV-snapshotted cache, misses are requests the tier had to
+    hand to the mvcc core (cold per-resource seed, backfill older than
+    the ring), and `watch_cache_ring_len` is the per-resource replay
+    ring depth — the "how much backfill can I serve" gauge. The bench
+    detail JSON reports hit/miss deltas per measured phase; a relist
+    storm that stays all-hits is the tier working."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or Registry()
+        self.registry = r
+        self.hits = r.counter(
+            "watch_cache_hits_total",
+            "LIST/watch requests served from the watch-cache tier "
+            "without touching the mvcc core")
+        self.misses = r.counter(
+            "watch_cache_misses_total",
+            "LIST/watch requests the watch-cache tier handed to the "
+            "mvcc core (cold resource seed, pre-ring backfill)")
+        self.ring_len = r.gauge(
+            "watch_cache_ring_len",
+            "Retained events in the per-resource watch-cache replay ring",
+            labels=("resource",))
+
+    def register_into(self, registry: Registry) -> None:
+        """Surface these through a server registry's render (the
+        WatchMetrics register_into pattern: same objects, one truth)."""
+        for m in (self.hits, self.misses, self.ring_len):
+            registry._metrics.setdefault(m.name, m)
 
 
 #: verbs counted as mutating for apiserver_current_inflight_requests'

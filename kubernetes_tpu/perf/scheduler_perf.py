@@ -26,7 +26,10 @@ slice of pods for queue pressure), startAgents (N in-process NodeAgents —
 hollow kubelets with field-selector pod watches — register their own
 Nodes in place of kwok-style data staging, so the run carries the
 control-plane cost of N watch consumers + mark-Running writes + lease
-heartbeats). Metrics collected over the measured phase:
+heartbeats), relistStorm (every started agent tears down its watch and
+cold-start relists AT ONCE — the watch-cache tier's measured scenario:
+N reads of one shared snapshot instead of N store scans).
+Metrics collected over the measured phase:
 SchedulingThroughput (pods/s), scheduling_attempt_duration percentiles
 (p50/p90/p99 from the scheduler's own histogram — SURVEY §5.5 names),
 node fragmentation % (mean free-capacity fraction; the bin-packing
@@ -101,6 +104,19 @@ class WorkloadResult:
         #: to O(events × watchers) shows up here as data.
         self.watch_events_dispatched_total = 0
         self.watch_predicate_checks_total = 0
+        #: Watch-cache serving-tier accounting over the measured phase
+        #: (store/cacher.py): LIST/watch-establishment requests served
+        #: from the RV-snapshotted cache vs handed to the mvcc core. A
+        #: relist storm that stays all-hits is the tier working.
+        self.watch_cache_hits_total = 0
+        self.watch_cache_misses_total = 0
+        #: relistStorm opcode results: wall time for every agent to tear
+        #: down its watch, LIST (off the shared snapshot) and re-watch at
+        #: once, plus the storm's own cache hit/miss deltas.
+        self.relist_storm_agents = 0
+        self.relist_storm_seconds = 0.0
+        self.relist_storm_cache_hits = 0
+        self.relist_storm_cache_misses = 0
         #: Policy-chain accounting over the measured phase
         #: (policy/vap.py + policy/audit.py): expression evaluations and
         #: audit stage events. A policy-chain regression (policies
@@ -149,6 +165,12 @@ class WorkloadResult:
                 self.watch_events_dispatched_total,
             "watch_predicate_checks_total":
                 self.watch_predicate_checks_total,
+            "watch_cache_hits_total": self.watch_cache_hits_total,
+            "watch_cache_misses_total": self.watch_cache_misses_total,
+            "relist_storm_agents": self.relist_storm_agents,
+            "relist_storm_seconds": round(self.relist_storm_seconds, 3),
+            "relist_storm_cache_hits": self.relist_storm_cache_hits,
+            "relist_storm_cache_misses": self.relist_storm_cache_misses,
             "policy_evaluations_total": self.policy_evaluations_total,
             "audit_events_total": self.audit_events_total,
             "solver_solve_chunks": self.solver_solve_chunks,
@@ -343,8 +365,9 @@ class PerfRunner:
                         NodeAgent(store, f"node-{node_count + i}",
                                   checkpoint_dir=agent_dir,
                                   node_template=copy.deepcopy(tmpl),
-                                  lease_period=float(
-                                      op.get("leasePeriod", 5.0)))
+                                  lease_period=float(_subst(
+                                      op.get("leasePeriod", 5.0),
+                                      params)))
                         for i in range(count)]
                     # Track BEFORE starting so a mid-window start()
                     # failure still stops every booted agent in the
@@ -509,6 +532,23 @@ class PerfRunner:
                         self._end_measure(result, metrics, backing,
                                           window, len(gated))
 
+                elif opcode == "relistStorm":
+                    # Every agent reconnects AT ONCE: tear down its
+                    # watch, full LIST, re-watch (agent.force_relist) —
+                    # the cold-start storm ROADMAP #2 names. With the
+                    # watch cache active the N LISTs are reads of one
+                    # shared snapshot (hit/miss deltas recorded); the
+                    # direct-mvcc path pays N table scans.
+                    h0, m0 = self._cache_totals(backing)
+                    t0 = time.monotonic()
+                    await asyncio.gather(
+                        *(a.force_relist() for a in agents))
+                    result.relist_storm_seconds = time.monotonic() - t0
+                    result.relist_storm_agents = len(agents)
+                    h1, m1 = self._cache_totals(backing)
+                    result.relist_storm_cache_hits = int(h1 - h0)
+                    result.relist_storm_cache_misses = int(m1 - m0)
+
                 elif opcode == "barrier":
                     await self._wait_bound(bound_keys, created_total, deadline)
 
@@ -604,6 +644,15 @@ class PerfRunner:
                 self._audit.sink.events_total._values.values())
         return evals, audits
 
+    @staticmethod
+    def _cache_totals(backing) -> tuple[float, float]:
+        """(hits, misses) of the store's watch-cache tier (0s when the
+        KTPU_WATCH_CACHE=0 kill switch disabled it)."""
+        cacher = getattr(backing, "cacher", None)
+        if cacher is None:
+            return 0.0, 0.0
+        return cacher.metrics.hits.value(), cacher.metrics.misses.value()
+
     def _begin_measure(self, metrics: SchedulerMetrics, backing) -> tuple:
         deg = metrics.backend_degradations
         wm = backing.watch_metrics
@@ -614,6 +663,7 @@ class PerfRunner:
             deg.value(kind="spread_poisoned"),
             wm.events_dispatched.value(),
             wm.predicate_checks.value(),
+            *self._cache_totals(backing),
             *self._policy_totals(),
             metrics.solve_duration.count(),
             metrics.solve_duration.sum(),
@@ -625,7 +675,8 @@ class PerfRunner:
                      metrics: SchedulerMetrics,
                      backing, window: tuple, count: int) -> None:
         (hist_base, t0, fallback_base, poisoned_base,
-         dispatched_base, checks_base, evals_base, audits_base,
+         dispatched_base, checks_base, cache_hits_base, cache_miss_base,
+         evals_base, audits_base,
          solve_chunks_base, solve_s_base, sl_pods_base,
          sl_fall_base, window_mark) = window
         dt = time.monotonic() - t0
@@ -661,6 +712,9 @@ class PerfRunner:
             wm.events_dispatched.value() - dispatched_base)
         result.watch_predicate_checks_total = int(
             wm.predicate_checks.value() - checks_base)
+        hits, misses = self._cache_totals(backing)
+        result.watch_cache_hits_total = int(hits - cache_hits_base)
+        result.watch_cache_misses_total = int(misses - cache_miss_base)
         evals, audits = self._policy_totals()
         result.policy_evaluations_total = int(evals - evals_base)
         result.audit_events_total = int(audits - audits_base)
@@ -724,7 +778,8 @@ def load_config(path: str) -> list[dict]:
 
 
 def run_suite(config: list[dict], backend_factory=None, batch_size: int = 1,
-              filter_name: str | None = None) -> dict[str, dict]:
+              filter_name: str | None = None, timeout: float = 600.0,
+              through_apiserver=False) -> dict[str, dict]:
     """Run every (testcase × workload) pair, like BenchmarkPerfScheduling."""
     out: dict[str, dict] = {}
     for case in config:
@@ -734,9 +789,11 @@ def run_suite(config: list[dict], backend_factory=None, batch_size: int = 1,
                 continue
             backend = backend_factory() if backend_factory else None
             runner = PerfRunner(backend=backend, batch_size=batch_size,
-                                scheduler_config=case.get("schedulerConfig"))
+                                scheduler_config=case.get("schedulerConfig"),
+                                through_apiserver=through_apiserver)
             res = asyncio.run(runner.run(
-                case["workloadTemplate"], wl.get("params") or {}))
+                case["workloadTemplate"], wl.get("params") or {},
+                timeout=timeout))
             out[full] = res.as_dict()
     return out
 
@@ -752,6 +809,14 @@ def main(argv: list[str] | None = None) -> int:
                          "signature); default lets the adaptive tuner "
                          "choose per measured latency/dirty ratio")
     ap.add_argument("--filter", default=None)
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-workload deadline in seconds (the 20k-agent "
+                         "family boots longer than the 600s default)")
+    ap.add_argument("--through-apiserver", choices=["", "http", "wire"],
+                    default="",
+                    help="cross the process boundary: all traffic (agent "
+                         "watches included) rides the chosen apiserver "
+                         "wire instead of direct store calls")
     args = ap.parse_args(argv)
 
     factory = None
@@ -762,8 +827,11 @@ def main(argv: list[str] | None = None) -> int:
         chunk = None if args.chunk is None \
             else max(min(args.chunk, batch), 2)
         factory = lambda: TPUBackend(max_batch=chunk)  # noqa: E731
+    boundary = {"": False, "http": True, "wire": "wire"}[
+        args.through_apiserver]
     results = run_suite(load_config(args.config), backend_factory=factory,
-                        batch_size=batch, filter_name=args.filter)
+                        batch_size=batch, filter_name=args.filter,
+                        timeout=args.timeout, through_apiserver=boundary)
     print(json.dumps(results, indent=2))
     return 0
 

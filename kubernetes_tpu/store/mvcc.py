@@ -179,6 +179,10 @@ def _fields_match(fields: Mapping[str, str], obj: Mapping) -> bool:
 class ListResult:
     items: list[dict]
     resource_version: int
+    #: snapshot-pinned continue token (`"<rv>:<last-key>"`) when a
+    #: limited page came off the watch-cache tier — every later page of
+    #: the same LIST is served at this page's snapshot RV, on any wire.
+    cont: str | None = None
 
 
 # Retain this many events for watch replay before declaring RVs expired.
@@ -262,6 +266,18 @@ class MVCCStore:
         #: shape (the reference apiserver indexes exactly this field).
         self._tracked_fields: dict[str, tuple[str, ...]] = {
             "pods": ("spec.nodeName", "status.phase")}
+        #: direct (uncached) LIST scans per resource — the smoke guard's
+        #: witness that a relist storm rides the cacher, not the table.
+        self.list_direct_total: dict[str, int] = {}
+        #: the watch-cache serving tier (store/cacher.py): RV-snapshotted
+        #: LISTs, ring-served watch backfill, pinned continue tokens.
+        #: Active by default; KTPU_WATCH_CACHE=0 is the kill switch that
+        #: degrades every read to the direct-mvcc path below.
+        self.cacher = None
+        if os.environ.get("KTPU_WATCH_CACHE", "1").lower() \
+                not in ("0", "false", "off"):
+            from kubernetes_tpu.store.cacher import Cacher
+            self.cacher = Cacher(self)
 
     # -- helpers -----------------------------------------------------------
 
@@ -297,6 +313,12 @@ class MVCCStore:
                 sink(resource, ev)
             except Exception:
                 logger.exception("event sink failed; write stays committed")
+        # Single fan-in for the serving tier (SURVEY §L0: the cacher's
+        # one store watch): the snapshot/ring absorb the event BEFORE
+        # watch dispatch, so a handler that reads during dispatch sees a
+        # cache consistent with the event it was handed.
+        if self.cacher is not None:
+            self.cacher.ingest(resource, ev)
         self._dispatch(resource, ev)
 
     def add_event_sink(self, sink) -> None:
@@ -686,8 +708,49 @@ class MVCCStore:
         limit: int = 0,
         continue_key: str | None = None,
         fields: Mapping[str, str] | None = None,
+        *,
+        resource_version: int | None = None,
+        resource_version_match: str | None = None,
+        copy: bool = True,
     ) -> ListResult:
-        """Consistent LIST with optional etcd-style limit/continue paging."""
+        """Consistent LIST, served from the watch-cache tier when active
+        (store/cacher.py documents the RV-semantics contract; `exact`
+        RVs and snapshot-pinned continue tokens ride the cacher's ring).
+        With the tier disabled, exact RVs other than the current one
+        raise Expired — the clean degradation the kill switch promises.
+        `copy=False` skips per-item deep copies for encode-only callers
+        (only honored on the cacher path; the direct path always copies).
+        """
+        from kubernetes_tpu.store.cacher import parse_continue
+        pinned_rv, cont = parse_continue(continue_key)
+        rv = pinned_rv if pinned_rv is not None else resource_version
+        exact = pinned_rv is not None or resource_version_match == "Exact"
+        if self.cacher is not None:
+            return await self.cacher.list(
+                resource, namespace, selector, limit, cont, fields,
+                resource_version=rv, exact=exact, copy=copy)
+        if rv and exact and rv != self._rv:
+            raise Expired(
+                f"resourceVersion {rv} is not servable (watch cache "
+                f"disabled; only the current RV {self._rv} is)")
+        return await self.list_direct(
+            resource, namespace, selector, limit, cont, fields)
+
+    async def list_direct(
+        self,
+        resource: str,
+        namespace: str | None = None,
+        selector: Selector | None = None,
+        limit: int = 0,
+        continue_key: str | None = None,
+        fields: Mapping[str, str] | None = None,
+    ) -> ListResult:
+        """The uncached mvcc scan: sorted table keys, filter, deep-copy.
+        The cacher's differential suite pins `list()` bit-equal to this
+        at matching RVs; `list_direct_total` counts these scans so the
+        relist-storm smoke can prove agents never land here."""
+        self.list_direct_total[resource] = \
+            self.list_direct_total.get(resource, 0) + 1
         table = self._table(resource)
         keys = sorted(table.keys())
         if continue_key:
@@ -724,25 +787,72 @@ class MVCCStore:
 
         rv=0 means "from now" (reference semantics for unset RV on the cacher
         path: start at current state — callers pair it with a LIST).
-        Raises Expired if rv predates the retained window.
+        Raises Expired if rv predates the retained window. With the
+        watch-cache tier active, backfill is served from the per-resource
+        ring (store/cacher.py); the direct path scans global history.
         """
+        if self.cacher is not None:
+            return await self.cacher.watch(
+                resource, resource_version, namespace, selector,
+                fields=fields, bookmarks=bookmarks)
+        return await self.watch_direct(
+            resource, resource_version, namespace, selector,
+            fields=fields, bookmarks=bookmarks)
+
+    async def watch_direct(
+        self,
+        resource: str,
+        resource_version: int = 0,
+        namespace: str | None = None,
+        selector: Selector | None = None,
+        *,
+        fields: Mapping[str, str] | None = None,
+        bookmarks: bool = True,
+    ) -> AsyncIterator[Event]:
+        """The uncached watch path: global-history backfill scan. Owns
+        the 410 window contract; the cacher falls back here for RVs its
+        ring no longer holds, so expiry behavior is identical on both.
+        RVs ahead of the store (a client that outlived an RV-resetting
+        restart) are Expired too — resuming there would silently drop
+        every event until the counter caught up; a relist recovers."""
+        if resource_version and resource_version > self._rv:
+            raise Expired(
+                f"resourceVersion {resource_version} is ahead of the "
+                f"store (current: {self._rv}); relist")
         if resource_version and resource_version + 1 < self._first_retained_rv:
             raise Expired(
                 f"resourceVersion {resource_version} is too old "
                 f"(oldest retained: {self._first_retained_rv})"
             )
-        chan = _WatchChannel(
-            queue=asyncio.Queue(), resource=resource,
-            namespace=namespace, selector=selector, fields=fields or None,
-        )
-        # Replay history strictly after rv, then go live. Registration happens
-        # before replay snapshot iteration completes atomically (single loop),
-        # so no event is lost between replay and live.
-        self._register_watcher(chan)
         replay = [
             ev for res, ev in self._events
             if res == resource and ev.rv > resource_version
         ] if resource_version else []
+        return self._open_watch(
+            resource, resource_version, namespace, selector,
+            fields=fields, bookmarks=bookmarks, replay=replay)
+
+    def _open_watch(
+        self,
+        resource: str,
+        resource_version: int,
+        namespace: str | None,
+        selector: Selector | None,
+        *,
+        fields: Mapping[str, str] | None,
+        bookmarks: bool,
+        replay: list[Event],
+    ) -> AsyncIterator[Event]:
+        """Register a channel and stream `replay` then live events —
+        shared by the ring-backfilled (cacher) and scan-backfilled
+        (direct) establishment paths. Registration and the caller's
+        replay computation happen in one loop tick, so no event is lost
+        between replay and live."""
+        chan = _WatchChannel(
+            queue=asyncio.Queue(), resource=resource,
+            namespace=namespace, selector=selector, fields=fields or None,
+        )
+        self._register_watcher(chan)
         self._ensure_bookmarks()
 
         async def gen() -> AsyncIterator[Event]:

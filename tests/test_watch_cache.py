@@ -1,0 +1,405 @@
+"""Watch-cache serving tier (store/cacher.py): randomized differential
+guards pinning the tier bit-equal to the mvcc core.
+
+- LIST-from-cacher vs LIST-from-mvcc equality at sampled RVs under
+  concurrent writes (the historical-snapshot rollback is exact);
+- watch backfill from the per-resource ring vs the store's global-scan
+  replay: identical event sequences for every watcher shape;
+- bookmark monotonicity;
+- ring overflow → too-old-RV (410) parity with the store path;
+- snapshot-pinned continue tokens: every page of one paginated LIST is
+  served at the first page's RV, identically on the HTTP and KTPU wires
+  (and via the gRPC pinned-token form).
+"""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from kubernetes_tpu.api.labels import parse_selector
+from kubernetes_tpu.store.mvcc import Expired, MVCCStore
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def canon(items) -> str:
+    return json.dumps(items, sort_keys=True)
+
+
+async def take(gen, n, timeout=2.0):
+    out = []
+    while len(out) < n:
+        ev = await asyncio.wait_for(gen.__anext__(), timeout)
+        if ev.type != "BOOKMARK":
+            out.append(ev)
+    await gen.aclose()
+    return out
+
+
+def fingerprint(evs):
+    return [(e.type, e.object["metadata"]["name"], e.rv) for e in evs]
+
+
+def _rand_labels(rng):
+    labels = {}
+    if rng.random() < 0.7:
+        labels["app"] = rng.choice(["web", "db"])
+    if rng.random() < 0.5:
+        labels["tier"] = rng.choice(["a", "b"])
+    return labels
+
+
+async def _churn(s: MVCCStore, rng: random.Random, steps: int,
+                 on_step=None, prefix: str = "o"):
+    """Random create/update/delete traffic over pods (labels, tracked +
+    untracked fields, namespaces). Concurrent writers must use disjoint
+    `prefix`es: each tracks its own alive-set, so shared keys would race
+    create-vs-create across await boundaries."""
+    names = [(f"{prefix}{i}", ("default", "ns1")[i % 2]) for i in range(16)]
+    alive = set()
+    for step in range(steps):
+        name, ns = rng.choice(names)
+        key = f"{ns}/{name}"
+        if key not in alive:
+            await s.create("pods", {
+                "metadata": {"name": name, "namespace": ns,
+                             "labels": _rand_labels(rng)},
+                "spec": {"nodeName": rng.choice(["", "n1", "n2"]),
+                         "untracked": rng.choice(["x", "y"])},
+                "status": {"phase": rng.choice(["Pending", "Running"])}})
+            alive.add(key)
+        elif rng.random() < 0.3:
+            await s.delete("pods", key)
+            alive.discard(key)
+        else:
+            cur = await s.get("pods", key)
+            mutation = rng.random()
+            if mutation < 0.4:
+                cur["metadata"]["labels"] = _rand_labels(rng)
+            elif mutation < 0.7:
+                cur["spec"]["nodeName"] = rng.choice(["", "n1", "n2"])
+            else:
+                cur["status"]["phase"] = rng.choice(
+                    ["Pending", "Running", "Succeeded"])
+            await s.update("pods", cur)
+        if on_step is not None:
+            await on_step(step)
+
+
+# LIST shapes the differential covers: plain, namespaced, selector,
+# tracked field, untracked field, joint.
+def _list_shapes():
+    return [
+        {},
+        {"namespace": "ns1"},
+        {"selector": parse_selector("app=web")},
+        {"fields": {"spec.nodeName": "n1"}},
+        {"fields": {"spec.untracked": "x"}},
+        {"namespace": "default", "fields": {"spec.nodeName": "n2"},
+         "selector": parse_selector("app")},
+    ]
+
+
+class TestListDifferential:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_sampled_rv_bit_equality_under_concurrent_writes(self, seed):
+        """At random points of a concurrent write stream, capture the
+        direct-mvcc LIST; the cacher must later reproduce it bit-exactly
+        from its historical snapshot at that RV."""
+        async def body():
+            rng = random.Random(seed)
+            s = MVCCStore()
+            assert s.cacher is not None  # active by default
+            await s.list("pods")  # touch: ring covers from rv 0
+            samples = []  # (rv, shape index, canonical direct items)
+
+            async def sample(step):
+                if rng.random() < 0.15:
+                    i = rng.randrange(len(_list_shapes()))
+                    direct = await s.list_direct(
+                        "pods", **_list_shapes()[i])
+                    samples.append(
+                        (direct.resource_version, i, canon(direct.items)))
+                if rng.random() < 0.3:
+                    await asyncio.sleep(0)  # let writers interleave
+
+            # Two concurrent writers + the sampler riding one of them.
+            await asyncio.gather(
+                _churn(s, rng, 120, on_step=sample),
+                _churn(s, random.Random(seed + 100), 120, prefix="q"))
+            assert len(samples) >= 5
+            for rv, i, want in samples:
+                got = await s.list("pods", **_list_shapes()[i],
+                                   resource_version=rv,
+                                   resource_version_match="Exact")
+                assert got.resource_version == rv
+                assert canon(got.items) == want, (rv, i)
+            # Current-RV equality across every shape, too.
+            for shape in _list_shapes():
+                a = await s.list("pods", **shape)
+                b = await s.list_direct("pods", **shape)
+                assert canon(a.items) == canon(b.items)
+                assert a.resource_version == b.resource_version
+            s.stop()
+        run(body())
+
+    def test_paging_pinned_to_snapshot_rv(self):
+        """Pages of one paginated LIST all serve the FIRST page's
+        snapshot, even with writes landing between pages."""
+        async def body():
+            s = MVCCStore()
+            for i in range(7):
+                await s.create("pods", {
+                    "metadata": {"name": f"p{i}", "namespace": "default"},
+                    "spec": {}})
+            baseline = await s.list_direct("pods")
+            page = await s.list("pods", limit=3)
+            rv0 = page.resource_version
+            assert page.cont and page.cont.startswith(f"{rv0}:")
+            pages = list(page.items)
+            cont = page.cont
+            k = 0
+            while cont:
+                # Writes between pages: must NOT leak into the snapshot.
+                await s.create("pods", {
+                    "metadata": {"name": f"late{k}",
+                                 "namespace": "default"}, "spec": {}})
+                await s.delete("pods", "default/p0") if k == 0 else None
+                k += 1
+                nxt = await s.list("pods", limit=3, continue_key=cont)
+                assert nxt.resource_version == rv0
+                pages.extend(nxt.items)
+                cont = nxt.cont
+                if not nxt.items:
+                    break
+            assert canon(pages) == canon(baseline.items)
+            s.stop()
+        run(body())
+
+
+def _oracle_replay(store: MVCCStore, shape: dict, after_rv: int):
+    """The expected backfill: the linear predicate scan over the store's
+    recorded history (the pre-cacher algorithm, verbatim — same oracle
+    as tests/test_watch_index.py)."""
+    from kubernetes_tpu.api.meta import namespace_of
+    from kubernetes_tpu.store.mvcc import _WatchChannel
+    chan = _WatchChannel(
+        queue=None, resource="pods", namespace=shape.get("namespace"),
+        selector=shape.get("selector"), fields=shape.get("fields"))
+    out = []
+    for res, ev in store._events:
+        if res != "pods" or ev.rv <= after_rv:
+            continue
+        if chan.namespace and namespace_of(ev.object) != chan.namespace:
+            continue
+        selected = MVCCStore._select_for(ev, chan)
+        if selected is not None:
+            out.append(selected)
+    return out
+
+
+class TestBackfillDifferential:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_ring_vs_store_replay_sequences(self, seed):
+        """Backfill served from the per-resource ring must be the exact
+        event sequence the store's global-history scan replays, for every
+        watcher shape (selector/field synthesis included)."""
+        async def body():
+            rng = random.Random(seed)
+            s = MVCCStore()
+            await s.list("pods")  # ring covers from rv 0
+            rvs = []
+
+            async def mark(step):
+                if rng.random() < 0.1:
+                    rvs.append(s.resource_version)
+
+            await _churn(s, rng, 150, on_step=mark)
+            shapes = [
+                {},
+                {"namespace": "ns1"},
+                {"selector": parse_selector("app=web")},
+                {"fields": {"spec.nodeName": "n1"}},
+                {"fields": {"spec.untracked": "x"}},
+            ]
+            assert rvs
+            for rv in rvs[:6]:
+                for shape in shapes:
+                    want = _oracle_replay(s, shape, rv)
+                    for opener in (s.watch, s.watch_direct):
+                        gen = await opener("pods", resource_version=rv,
+                                           **shape)
+                        got = await take(gen, len(want)) if want else []
+                        if not want:
+                            await gen.aclose()
+                        assert fingerprint(got) == fingerprint(want), \
+                            (opener.__name__, rv, shape)
+            s.stop()
+        run(body())
+
+
+class TestBookmarksAndExpiry:
+    def test_bookmark_rvs_monotonic_and_progress(self, monkeypatch):
+        async def body():
+            from kubernetes_tpu.store import mvcc
+            monkeypatch.setattr(mvcc, "BOOKMARK_INTERVAL_S", 0.03)
+            s = MVCCStore()
+            gen = await s.watch("pods")
+            marks = []
+
+            async def consume():
+                async for ev in gen:
+                    if ev.type == "BOOKMARK":
+                        marks.append(ev.rv)
+                        if len(marks) >= 3:
+                            return
+
+            task = asyncio.ensure_future(consume())
+            for i in range(5):
+                await s.create("pods", {
+                    "metadata": {"name": f"p{i}", "namespace": "default"},
+                    "spec": {}})
+                await asyncio.sleep(0.03)
+            await asyncio.wait_for(task, 3.0)
+            assert marks == sorted(marks)
+            assert marks[-1] >= 1  # carries real store progress
+            assert marks[-1] <= s.resource_version
+            s.stop()
+        run(body())
+
+    def test_future_rv_expires_on_both_watch_paths(self):
+        """An RV ahead of the store (a client that outlived an
+        RV-resetting restart) must 410 into a relist on BOTH paths —
+        silently resuming would drop every event until the new counter
+        caught up to the stale RV."""
+        async def body():
+            s = MVCCStore()
+            await s.create("pods", {
+                "metadata": {"name": "p0", "namespace": "default"},
+                "spec": {}})
+            with pytest.raises(Expired):
+                await s.watch("pods", resource_version=999)
+            with pytest.raises(Expired):
+                await s.watch_direct("pods", resource_version=999)
+            s.stop()
+        run(body())
+
+    def test_ring_overflow_too_old_parity(self):
+        """When the retained window is exceeded, BOTH paths 410 — the
+        cacher must not resurrect RVs the store has compacted."""
+        async def body():
+            s = MVCCStore(event_window=6)
+            await s.list("pods")  # cache alive from rv 0
+            for i in range(30):
+                await s.create("pods", {
+                    "metadata": {"name": f"p{i}", "namespace": "default"},
+                    "spec": {}})
+            with pytest.raises(Expired):
+                await s.watch_direct("pods", resource_version=2)
+            with pytest.raises(Expired):
+                await s.watch("pods", resource_version=2)
+            with pytest.raises(Expired):
+                await s.list("pods", resource_version=2,
+                             resource_version_match="Exact")
+            # Recent RVs (inside the ring) still serve.
+            recent = s.resource_version - 2
+            got = await s.list("pods", resource_version=recent,
+                               resource_version_match="Exact")
+            assert got.resource_version == recent
+            assert len(got.items) == 28
+            s.stop()
+        run(body())
+
+
+class TestCrossWireParity:
+    def test_http_and_ktpu_pages_pin_one_snapshot_rv(self):
+        """Satellite: the two wires must agree on the snapshot RV across
+        pages of a paginated LIST, with writes landing between pages."""
+        async def body():
+            from kubernetes_tpu.apiserver.client import RemoteStore
+            from kubernetes_tpu.apiserver.server import APIServer
+            from kubernetes_tpu.apiserver.wire import WireServer, WireStore
+            s = MVCCStore()
+            for i in range(6):
+                await s.create("pods", {
+                    "metadata": {"name": f"p{i}", "namespace": "default"},
+                    "spec": {}})
+            api = APIServer(s)
+            await api.start()
+            wire = WireServer.for_apiserver(api, host="unix:")
+            await wire.start()
+            http = RemoteStore(api.url)
+            ktpu = WireStore(wire.target)
+            try:
+                h1 = await http.list("pods", limit=4)
+                k1 = await ktpu.list("pods", limit=4)
+                assert h1.resource_version == k1.resource_version
+                # Writes land between pages on both wires.
+                for i in range(3):
+                    await s.create("pods", {
+                        "metadata": {"name": f"late{i}",
+                                     "namespace": "default"}, "spec": {}})
+                h2 = await http.list("pods", continue_key=h1.cont)
+                k2 = await ktpu.list("pods", continue_key=k1.cont)
+                # Page 2 stays pinned to page 1's snapshot on BOTH wires:
+                # the late* pods are invisible, the RV is page 1's.
+                assert h2.resource_version == h1.resource_version
+                assert k2.resource_version == k1.resource_version
+                names_h = [p["metadata"]["name"]
+                           for p in h1.items + h2.items]
+                names_k = [p["metadata"]["name"]
+                           for p in k1.items + k2.items]
+                assert names_h == names_k == [f"p{i}" for i in range(6)]
+            finally:
+                await ktpu.close()
+                await http.close()
+                await wire.stop()
+                await api.stop()
+                s.stop()
+        run(body())
+
+    def test_grpc_exact_rv_via_pinned_token(self):
+        """gRPC needs no proto change: '<rv>:' continue tokens give it
+        the same exact-RV snapshot reads as the other wires."""
+        async def body():
+            from kubernetes_tpu.apiserver.grpc_server import (
+                GRPCAPIServer,
+                GRPCRemoteStore,
+            )
+            s = MVCCStore()
+            for i in range(4):
+                await s.create("pods", {
+                    "metadata": {"name": f"p{i}", "namespace": "default"},
+                    "spec": {}})
+            rv0 = s.resource_version
+            for i in range(3):
+                await s.create("pods", {
+                    "metadata": {"name": f"late{i}",
+                                 "namespace": "default"}, "spec": {}})
+            srv = GRPCAPIServer(s)
+            await srv.start()
+            rs = GRPCRemoteStore(srv.target)
+            try:
+                lst = await rs.list("pods", resource_version=rv0,
+                                    resource_version_match="Exact")
+                assert lst.resource_version == rv0
+                assert [p["metadata"]["name"] for p in lst.items] == \
+                    [f"p{i}" for i in range(4)]
+                # Pinned pagination: the client-rebuilt token resumes at
+                # the same snapshot.
+                page = await rs.list("pods", limit=2,
+                                     resource_version=rv0,
+                                     resource_version_match="Exact")
+                rest = await rs.list("pods", continue_key=page.cont)
+                assert rest.resource_version == rv0
+                assert [p["metadata"]["name"]
+                        for p in page.items + rest.items] == \
+                    [f"p{i}" for i in range(4)]
+            finally:
+                await rs.close()
+                await srv.stop()
+                s.stop()
+        run(body())
