@@ -33,6 +33,10 @@ PRESETS = {
     # config #5 scale: 50k nodes (KWOK-style, nodes are data); the node
     # dimension is what multi-slice sharding scales (SURVEY §5.7).
     "50k": (50000, 500, 5000),
+    # Sharded-control-plane scale (ROADMAP #5): above KTPU_SHARD_THRESHOLD
+    # the store/informer/host-prep path partitions into per-shard mvcc
+    # stores (store/sharded.py) — flagless; --shards/KTPU_SHARDS override.
+    "200k": (200000, 500, 5000),
 }
 
 
@@ -53,6 +57,13 @@ def main(argv=None) -> int:
                          "adaptive tuner picks chunk AND pipeline depth "
                          "from warmup-measured transfer latency and "
                          "dirty-upload ratio (BASELINE.md r6 envelope)")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="OVERRIDE the control-plane shard count (the "
+                         "sweep knob; 1 = the classic single store). "
+                         "Default: flagless — node counts at or above "
+                         "KTPU_SHARD_THRESHOLD (100k) activate "
+                         "KTPU_SHARDS or 8 shards; below it the r12 "
+                         "single-store path runs bit-for-bit")
     ap.add_argument("--shortlist-k", type=int, default=None,
                     help="OVERRIDE the solver shortlist width (0 disables "
                          "the pruned solve — the before/after sweep knob). "
@@ -124,6 +135,10 @@ def main(argv=None) -> int:
         DEFAULT_FEATURE_GATES.set_from_spec(args.feature_gates)
 
     nodes, warmup, measured = PRESETS[args.preset]
+    from kubernetes_tpu.store.sharded import control_plane_shards
+    # PerfRunner owns propagating the override (it scopes KTPU_SHARDS
+    # around the run so the host prep's policy sees the same S).
+    shards = control_plane_shards(nodes, args.shards)
     backend = None
     batch = 1
     if DEFAULT_FEATURE_GATES.enabled("TPUScorer"):
@@ -169,7 +184,8 @@ def main(argv=None) -> int:
                         profile_dir=args.profile_dir or None,
                         policy_count=args.policy_set,
                         audit_rules=[{"level": args.audit_level}]
-                        if args.audit_level else None)
+                        if args.audit_level else None,
+                        shards=shards)
     res = asyncio.run(runner.run(template, params, timeout=1800.0))
 
     if tracer is not None:

@@ -96,9 +96,39 @@ class NodeAgent:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        await self._start_register()
+        await self._start_sync()
+
+    @classmethod
+    async def start_many(cls, agents, window: int = 512) -> None:
+        """Batched cold start for an agent fleet (the r12-identified 50k
+        headroom: agent STARTUP cost, not the read path). A per-agent
+        `start()` serializes its own handshake — register → LIST →
+        watch — so a fleet gathered over start() keeps one loop tick per
+        agent per round trip. This runs the fleet in two WIDE phases
+        instead: every registration first (a window's node creates
+        coalesce into one multiplexed wire frame), then every
+        LIST+WATCH establishment (the LISTs read one shared watch-cache
+        snapshot; on a sharded control plane the S-shard fan-in serves
+        windows concurrently instead of serializing per-agent
+        handshakes). Windowed so a mid-boot failure still leaves every
+        started agent stoppable."""
+        agents = list(agents)
+        for lo in range(0, len(agents), window):
+            await asyncio.gather(
+                *(a._start_register() for a in agents[lo:lo + window]))
+        for lo in range(0, len(agents), window):
+            await asyncio.gather(
+                *(a._start_sync() for a in agents[lo:lo + window]))
+
+    async def _start_register(self) -> None:
+        """Phase 1: local checkpoint restore + Node registration."""
         self.ledger.load()
         if self.register:
             await self._register_node()
+
+    async def _start_sync(self) -> None:
+        """Phase 2: startup reconcile LIST, watch + lease establishment."""
         # Startup reconcile (syncLoop HandlePodCleanups): restore the
         # checkpoint against the live bound-pod set, then prime workers.
         lst = await self.store.list(
@@ -478,6 +508,15 @@ class NodeAgent:
         lease, transport error) just drops the local copy and re-seeds."""
         key = f"kube-node-lease/{self.node_name}"
         lease: dict | None = None
+        # Jittered first tick (client-go wait.Jitter on heartbeats): a
+        # fleet cold start must not race its own boot — N first-lease
+        # creates landing inside the registration/watch-establishment
+        # window were ~half the boot-phase write load (the r12 50k-agent
+        # headroom note). Deterministic per node name, so boots replay.
+        import zlib
+        await asyncio.sleep(
+            min(self.lease_period, 2.0)
+            * (zlib.crc32(self.node_name.encode()) % 1000) / 1000.0)
         while not self._stopped:
             try:
                 if lease is None:

@@ -652,6 +652,12 @@ class _Conn(asyncio.Protocol):
             # watch-cache tier (store/cacher.py) — same contract as the
             # HTTP wire's resourceVersion/resourceVersionMatch params,
             # so paginated pages agree on one snapshot RV across wires.
+            kw = {}
+            if args.get("shard") is not None \
+                    and hasattr(store, "node_shards"):
+                # Shard-scoped LIST (per-shard informer relists) —
+                # ignored when the backing store is unsharded.
+                kw["shard"] = int(args["shard"])
             lst = await store.list(
                 resource, namespace=args.get("namespace"),
                 selector=sel, limit=int(args.get("limit") or 0),
@@ -659,7 +665,7 @@ class _Conn(asyncio.Protocol):
                 fields=args.get("fields") or None,
                 resource_version=int(args.get("rv") or 0) or None,
                 resource_version_match=args.get("rvMatch"),
-                copy=False)  # encode-only: packed before return
+                copy=False, **kw)  # encode-only: packed before return
             out = {"items": lst.items, "rv": lst.resource_version}
             if lst.cont:
                 out["cont"] = lst.cont
@@ -669,6 +675,13 @@ class _Conn(asyncio.Protocol):
                     "clusterScoped": sorted(
                         r for r in set(store.kind_map().values())
                         if store.is_cluster_scoped(r))}
+        if op == "topology":
+            # Control-plane shape discovery: a sharded backing store
+            # advertises its shard count + partitioned resources so
+            # clients can open per-shard watches (ShardedInformer).
+            return {"nodeShards": int(getattr(store, "node_shards", 1)),
+                    "partitioned": list(
+                        getattr(store, "partitioned_resources", ()))}
         raise ValueError(f"unknown op {op!r}")
 
     # -- watch push --------------------------------------------------------
@@ -684,11 +697,15 @@ class _Conn(asyncio.Protocol):
         # after this watch frame is guaranteed to reach it. Spawning the
         # registration into the pump task would let an rv=0 ("from now")
         # watch miss writes that arrived just behind it.
+        kw = {}
+        if args.get("shard") is not None \
+                and hasattr(self.server.store, "node_shards"):
+            kw["shard"] = int(args["shard"])
         try:
             watch = await self.server.store.watch(
                 resource, resource_version=int(args.get("rv") or 0),
                 namespace=args.get("namespace"), selector=sel,
-                fields=args.get("fields") or None)
+                fields=args.get("fields") or None, **kw)
         except Expired as e:
             self.send(_encode_reply([wid, "exp", str(e)], self._mp))
             return
@@ -1194,6 +1211,7 @@ class WireStore:
         *,
         resource_version: int | None = None,
         resource_version_match: str | None = None,
+        shard: int | None = None,
         **_kw,
     ) -> ListResult:
         args = {
@@ -1204,6 +1222,8 @@ class WireStore:
         if resource_version:
             args["rv"] = resource_version
             args["rvMatch"] = resource_version_match
+        if shard is not None:
+            args["shard"] = int(shard)
         resp = await self._call("list", resource, args)
         return ListResult(items=resp["items"],
                           resource_version=int(resp["rv"]),
@@ -1213,6 +1233,7 @@ class WireStore:
         self, resource: str, resource_version: int = 0,
         namespace: str | None = None, selector: Selector | None = None,
         fields: Mapping[str, str] | None = None,
+        shard: int | None = None,
         **_kw,
     ) -> AsyncIterator[Event]:
         await self._ensure()
@@ -1220,10 +1241,13 @@ class WireStore:
         wid = f"w{self._next_id}"
         w = _WireWatch(wid)
         self._watches[wid] = w
-        self._send([wid, "watch", resource, {
+        args = {
             "rv": resource_version or 0, "namespace": namespace,
             "selector": selector_to_string(selector) or None,
-            "fields": dict(fields) if fields else None}])
+            "fields": dict(fields) if fields else None}
+        if shard is not None:
+            args["shard"] = int(shard)
+        self._send([wid, "watch", resource, args])
 
         async def gen() -> AsyncIterator[Event]:
             try:
@@ -1247,6 +1271,23 @@ class WireStore:
         return gen()
 
     # -- discovery (RESTMapper analog, used by CLI-ish consumers) ----------
+
+    async def control_topology(self) -> dict:
+        """Server control-plane shape ({"nodeShards": S, "partitioned":
+        [...]}), cached — ShardedInformer calls this once per informer
+        start to decide between per-shard and single-stream reflectors.
+        Servers predating the op report the unsharded shape."""
+        if getattr(self, "_topology", None) is None:
+            try:
+                self._topology = await self._call("topology")
+            except Exception:
+                # Do NOT cache the failure: a transient error at probe
+                # time must not pin this connection to the single-stream
+                # path forever — the next informer start retries.
+                logger.warning("topology probe failed; assuming an "
+                               "unsharded server this time", exc_info=True)
+                return {"nodeShards": 1, "partitioned": []}
+        return self._topology
 
     async def refresh_discovery(self) -> None:
         resp = await self._call("kinds")

@@ -6,6 +6,7 @@ from kubernetes_tpu.client.informer import (
     InformerFactory,
     ResourceEventHandler,
     SharedInformer,
+    ShardedInformer,
     namespace_index,
 )
 from kubernetes_tpu.client.workqueue import (
@@ -22,6 +23,7 @@ __all__ = [
     "InformerFactory",
     "ResourceEventHandler",
     "SharedInformer",
+    "ShardedInformer",
     "namespace_index",
     "DelayingQueue",
     "ExponentialFailureRateLimiter",

@@ -438,6 +438,25 @@ class SchedulerMetrics:
         self.solver_shortlist_fallbacks = r.counter(
             "scheduler_tpu_solver_shortlist_fallbacks_total",
             "Pods whose shortlist bound check fell back to the full row")
+        #: Sharded-control-plane observability (ROADMAP #5): per-shard
+        #: host-prep rebuild counts (a shard increments only when its
+        #: rows were actually rewritten — the incremental path's
+        #: witness), the device-solve wall attributed to the sharded
+        #: path (one fused program spans every shard on this hardware,
+        #: so the label carries the shard COUNT the solve ran under,
+        #: not a shard id), and the top-level cross-shard argmax
+        #: reductions (one per pod step when S > 1).
+        self.shard_tensor_rebuilds = r.counter(
+            "scheduler_tpu_shard_tensor_rebuilds_total",
+            "Host-prep tensor rebuilds per control-plane shard",
+            labels=("shard",))
+        self.shard_solve_seconds = r.counter(
+            "scheduler_tpu_shard_solve_seconds_total",
+            "Device-solve wall under the sharded control plane",
+            labels=("shards",))
+        self.cross_shard_reductions = r.counter(
+            "scheduler_tpu_cross_shard_reductions_total",
+            "Top-level cross-shard argmax reductions (pod steps)")
 
         #: exact windowed percentile recorders riding attempt_duration's
         #: observe path, keyed by (result, profile) — the same population

@@ -550,6 +550,10 @@ class TPUBackend:
         #: SchedulerMetrics, injected by the Scheduler — degradation
         #: counters (spread poisoning, gang overflow) report through it.
         self.metrics = None
+        #: control-plane shard count of the backing store, injected by
+        #: Scheduler.attach_backend when the store advertises one
+        #: (ShardedNodeStore.node_shards); None = the flagless policy.
+        self.control_shards = None
         #: utils/tracing.Tracer, injected by Scheduler.attach_backend —
         #: per-chunk solver.dispatch/solver.solve spans nest under the
         #: scheduler's attempt span when tracing is on.
@@ -642,8 +646,15 @@ class TPUBackend:
     def _tensors(self, snapshot: Snapshot) -> ClusterTensors:
         if self._ct is None or self._ct.generation != snapshot.generation:
             self._ct = ClusterTensors(
-                snapshot, resources=self._pinned_resources, prev=self._ct)
+                snapshot, resources=self._pinned_resources, prev=self._ct,
+                shards=self.control_shards)
             self._affinity = None  # resident pods changed → recompile
+            # Per-shard host-prep accounting (ROADMAP #5): only shards
+            # whose rows this build rewrote count a rebuild — the
+            # incremental delta path's observable witness.
+            if self.metrics is not None:
+                for s in self._ct.shard_rebuilds:
+                    self.metrics.shard_tensor_rebuilds.inc(shard=str(s))
         if self._row_fp != self._ct._static_fp:
             self._row_cache.clear()
             self._row_fp = self._ct._static_fp
@@ -2185,6 +2196,14 @@ class TPUBackend:
                 self.metrics.solver_shortlist_pods.inc(batch.p_real)
                 if nfall:
                     self.metrics.solver_shortlist_fallbacks.inc(nfall)
+            if ctx.ct.prep_shards > 1:
+                # Sharded-path solve accounting: the fused program spans
+                # every shard, so the wall is labeled with the shard
+                # COUNT; the top-level argmax merges once per pod step.
+                self.metrics.shard_solve_seconds.inc(
+                    run.get("solve_wall_s", 0.0),
+                    shards=str(ctx.ct.prep_shards))
+                self.metrics.cross_shard_reductions.inc(batch.p_real)
 
         # Host verify + working-state accumulation (hard part #1). The
         # verify context is shared across chunks, so later chunks are

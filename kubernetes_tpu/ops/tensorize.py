@@ -130,9 +130,27 @@ class ClusterTensors:
     """
 
     def __init__(self, snapshot: Snapshot, resources: Sequence[str] | None = None,
-                 prev: "ClusterTensors | None" = None):
+                 prev: "ClusterTensors | None" = None,
+                 shards: int | None = None):
         nodes = snapshot.nodes
         self.generation = snapshot.generation
+        #: control-plane shard count for the prep accounting: the
+        #: backing store's actual S when the caller knows it (the
+        #: scheduler threads it from an in-process ShardedNodeStore),
+        #: else resolved from the flagless policy.
+        self._shards_override = shards
+        #: incremental-prep handles (SchedulerCache stamps them on its
+        #: snapshots; -1 = unknown, the legacy full-walk path).
+        self.set_epoch = getattr(snapshot, "set_epoch", -1)
+        self.spec_seq = getattr(snapshot, "spec_seq", -1)
+        #: per-shard prep accounting (filled by both build paths):
+        #: control-plane shard ids over the node axis and which shards'
+        #: rows this build actually rewrote.
+        self.prep_shards = 1
+        self.shard_ids: np.ndarray | None = None
+        self.shard_rebuilds: list[int] = []
+        if self._init_delta(snapshot, resources, prev):
+            return
         self.node_names = [ni.name for ni in nodes]
         self.name_to_idx = {n: i for i, n in enumerate(self.node_names)}
         self.n_real = len(nodes)
@@ -219,6 +237,104 @@ class ClusterTensors:
             self.taint_filter_mat, self.taint_prefer_mat = \
                 self.taints.node_rows(nodes, N)
         self._static_fp = fp
+        self._shard_accounting(
+            prev=prev if incremental else None,
+            changed=changed if incremental else None)
+
+    # -- shard-local delta build (the 200k control-plane path) --------------
+
+    def _init_delta(self, snapshot: Snapshot,
+                    resources: Sequence[str] | None,
+                    prev: "ClusterTensors | None") -> bool:
+        """Per-shard incremental build off the cache's event stream.
+
+        When the node SET and every node OBJECT are unchanged since
+        `prev` (set_epoch / spec_seq match) and the cache's changed-log
+        still covers prev.generation, every O(N) walk of the full build
+        is skipped: the static pieces (names, resource columns, scales,
+        allocatable, taints) are SHARED with prev — spec_seq pins them
+        identical, and the caller discards prev — while the used-state
+        arrays are copied and only the rows of nodes whose generation
+        advanced are re-quantized, grouped by control-plane shard for
+        the rebuild accounting. O(changed) per generation instead of
+        O(N): the host-prep half of ROADMAP #5's sharded scale-out.
+        Node order is untouched, so assignments (and the index tie
+        rule) stay bit-identical to the full build."""
+        if prev is None or self.set_epoch < 0 \
+                or self.set_epoch != getattr(prev, "set_epoch", -2) \
+                or self.spec_seq != getattr(prev, "spec_seq", -2):
+            return False
+        changed_fn = getattr(snapshot, "changed_since", None)
+        if changed_fn is None:
+            return False
+        changed = changed_fn(prev.generation)
+        if changed is None:
+            return False
+        nodes = snapshot.nodes
+        if len(nodes) != prev.n_real:
+            return False  # stale epoch counters: take the full walk
+        self.node_names = prev.node_names
+        self.name_to_idx = prev.name_to_idx
+        self.n_real = prev.n_real
+        self.n_pad = prev.n_pad
+        self.resources = prev.resources
+        self.r_index = prev.r_index
+        self.scales = prev.scales
+        self.alloc_q = prev.alloc_q
+        self.alloc_pods = prev.alloc_pods
+        self.valid = prev.valid
+        self.taints = prev.taints
+        self.taint_filter_mat = prev.taint_filter_mat
+        self.taint_prefer_mat = prev.taint_prefer_mat
+        self._static_fp = prev._static_fp
+        self.node_gens = list(prev.node_gens)
+        self.used_q = prev.used_q.copy()
+        self.used_nz_q = prev.used_nz_q.copy()
+        self.used_pods = prev.used_pods.copy()
+        sc = self.scales
+        for i in changed:
+            ni = nodes[i]
+            self.node_gens[i] = ni.generation
+            for j, r in enumerate(self.resources):
+                self.used_q[i, j] = _quant_ceil(ni.requested.get(r), sc[j])
+                self.used_nz_q[i, j] = _quant_ceil(
+                    ni.nonzero_requested.get(r), sc[j])
+            self.used_pods[i] = ni.requested.pods
+        self._shard_accounting(prev=prev, changed=changed)
+        return True
+
+    def _shard_accounting(self, prev: "ClusterTensors | None",
+                          changed) -> None:
+        """Which control-plane shards' rows this build rewrote.
+        `changed=None` means a full rebuild (every shard). Shard ids
+        are computed once per node-set epoch and shared with prev."""
+        from kubernetes_tpu.store.sharded import (
+            control_plane_shards,
+            shard_of,
+        )
+        S = control_plane_shards(self.n_real, self._shards_override)
+        self.prep_shards = S
+        if S <= 1:
+            self.shard_rebuilds = [0] if (changed is None or changed) \
+                else []
+            return
+        if prev is not None and prev.shard_ids is not None \
+                and prev.prep_shards == S \
+                and len(prev.shard_ids) == self.n_real:
+            self.shard_ids = prev.shard_ids
+        else:
+            self.shard_ids = np.fromiter(
+                (shard_of(n, S) for n in self.node_names),
+                dtype=np.int32, count=self.n_real)
+        if changed is None:
+            self.shard_rebuilds = list(range(S))
+        elif changed:
+            self.shard_rebuilds = sorted(
+                int(s) for s in np.unique(
+                    self.shard_ids[np.fromiter(
+                        changed, dtype=np.intp, count=len(changed))]))
+        else:
+            self.shard_rebuilds = []
 
     # -- per-pod compilation -------------------------------------------------
 

@@ -23,6 +23,16 @@ split of capacity-independent vs live-rescored score components):
 Both are mesh-size-agnostic (a (1,)-mesh degrades to the single-chip path)
 and compile once per (mesh, strategy) — jitted programs are cached on the
 hashable Mesh itself, with scalar weights as traced arguments.
+
+Pairing with the sharded CONTROL plane (store/sharded.py, r13): the
+per-shard host prep maintains the node axis in GLOBAL order (hash shards
+own scattered row sets, never reordered), so the arrays these solvers
+consume are the same ones the single-store path produces — the device
+mesh is free to block-partition that axis over chips while the control
+plane hash-partitions it over stores, and the per-step `pmax`/`pmin`
+winner reduction below IS the cross-shard argmax of both decompositions
+(assignments stay bit-identical to the unsharded path by the index tie
+rule; tests/test_sharded_parity.py pins it end to end).
 """
 
 from __future__ import annotations

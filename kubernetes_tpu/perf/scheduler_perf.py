@@ -135,6 +135,18 @@ class WorkloadResult:
         self.solver_scan_width = 0
         self.solver_shortlist_pods_total = 0
         self.solver_shortlist_fallbacks_total = 0
+        #: Sharded-control-plane accounting (ROADMAP #5): the run's
+        #: shard count (1 = classic single store), per-shard host-prep
+        #: rebuilds over the measured phase (the incremental path keeps
+        #: this at dirty-shards-only), the solve wall attributed to the
+        #: sharded path, and the top-level cross-shard argmax steps.
+        self.shard_count = 1
+        self.shard_tensor_rebuilds_total = 0
+        self.shard_solve_seconds = 0.0
+        self.cross_shard_reductions_total = 0
+        #: startAgents opcode wall (the cold-start fleet boot measured
+        #: by the agent-batching satellite; 0.0 when no agents started).
+        self.agent_start_seconds = 0.0
 
     def as_dict(self) -> dict:
         import math
@@ -183,6 +195,11 @@ class WorkloadResult:
                 100.0 * (1.0 - self.solver_shortlist_fallbacks_total
                          / self.solver_shortlist_pods_total), 2)
             if self.solver_shortlist_pods_total else None,
+            "shard_count": self.shard_count,
+            "shard_tensor_rebuilds_total": self.shard_tensor_rebuilds_total,
+            "shard_solve_seconds": round(self.shard_solve_seconds, 3),
+            "cross_shard_reductions_total": self.cross_shard_reductions_total,
+            "agent_start_seconds": round(self.agent_start_seconds, 3),
         }
 
 
@@ -216,10 +233,14 @@ class PerfRunner:
                  through_apiserver: bool = False,
                  profile_dir: str | None = None,
                  policy_count: int = 0,
-                 audit_rules: list | None = None):
+                 audit_rules: list | None = None,
+                 shards: int | None = None):
         self.backend = backend
         self.batch_size = batch_size
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        #: control-plane shard count for the backing store (>1 builds a
+        #: ShardedNodeStore; None resolves KTPU_SHARDS, default 1).
+        self.shards = shards
         #: ValidatingAdmissionPolicies (+bindings) installed before the
         #: run — the policy-chain overhead knob (BASELINE r9: headline
         #: with a 10-policy set vs disabled). Only meaningful with
@@ -246,7 +267,27 @@ class PerfRunner:
 
     async def run(self, template_ops: list, params: Mapping[str, Any],
                   timeout: float = 600.0) -> WorkloadResult:
-        backing = new_cluster_store()
+        import os
+        if self.shards is None:
+            return await self._run_inner(template_ops, params, timeout)
+        # The host prep's per-shard accounting resolves the same
+        # flagless policy (control_plane_shards); an explicit shard
+        # request must reach it too — scoped to this run (local save so
+        # overlapping runs can't cross-restore each other's value).
+        prev = os.environ.get("KTPU_SHARDS")
+        os.environ["KTPU_SHARDS"] = str(self.shards)
+        try:
+            return await self._run_inner(template_ops, params, timeout)
+        finally:
+            if prev is None:
+                os.environ.pop("KTPU_SHARDS", None)
+            else:
+                os.environ["KTPU_SHARDS"] = prev
+
+    async def _run_inner(self, template_ops: list,
+                         params: Mapping[str, Any],
+                         timeout: float = 600.0) -> WorkloadResult:
+        backing = new_cluster_store(shards=self.shards)
         install_core_validation(backing)
         server = None
         client = None
@@ -369,16 +410,18 @@ class PerfRunner:
                                       op.get("leasePeriod", 5.0),
                                       params)))
                         for i in range(count)]
-                    # Track BEFORE starting so a mid-window start()
-                    # failure still stops every booted agent in the
-                    # finally block (stop() on a never-started agent is
-                    # a no-op). Windowed start: each start() lists +
-                    # opens a watch; a serial loop would make agent boot
-                    # the benchmark.
+                    # Track BEFORE starting so a mid-boot failure still
+                    # stops every booted agent in the finally block
+                    # (stop() on a never-started agent is a no-op).
+                    # Batched fleet boot (NodeAgent.start_many): wide
+                    # registration windows first, then wide watch
+                    # establishment — per-agent serialized handshakes
+                    # were the r12-identified 50k-agent headroom.
                     agents.extend(new_agents)
-                    for lo in range(0, count, 64):
-                        await asyncio.gather(*(
-                            a.start() for a in new_agents[lo:lo + 64]))
+                    t0 = time.monotonic()
+                    from kubernetes_tpu.agent.agent import NodeAgent as _NA
+                    await _NA.start_many(new_agents)
+                    result.agent_start_seconds += time.monotonic() - t0
                     node_count += count
 
                 elif opcode == "createNodes":
@@ -606,6 +649,7 @@ class PerfRunner:
             result.attempt_p99 = h.percentile(0.99, **labels)
         result.scheduled_total = _result_count(metrics, "scheduled")
         result.unschedulable_total = _result_count(metrics, "unschedulable")
+        result.shard_count = int(getattr(backing, "node_shards", 1))
         result.fragmentation_pct = self._fragmentation(sched)
         result.events_emitted_total = sched.recorder.emitted
         result.events_dropped_total = sched.recorder.dropped
@@ -669,6 +713,9 @@ class PerfRunner:
             metrics.solve_duration.sum(),
             metrics.solver_shortlist_pods.value(),
             metrics.solver_shortlist_fallbacks.value(),
+            sum(metrics.shard_tensor_rebuilds._values.values()),
+            sum(metrics.shard_solve_seconds._values.values()),
+            metrics.cross_shard_reductions.value(),
             metrics.attempt_window().mark())
 
     def _end_measure(self, result: WorkloadResult,
@@ -678,7 +725,8 @@ class PerfRunner:
          dispatched_base, checks_base, cache_hits_base, cache_miss_base,
          evals_base, audits_base,
          solve_chunks_base, solve_s_base, sl_pods_base,
-         sl_fall_base, window_mark) = window
+         sl_fall_base, shard_rb_base, shard_s_base, xshard_base,
+         window_mark) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
         result.measured_seconds = dt
@@ -727,6 +775,14 @@ class PerfRunner:
             metrics.solver_shortlist_pods.value() - sl_pods_base)
         result.solver_shortlist_fallbacks_total = int(
             metrics.solver_shortlist_fallbacks.value() - sl_fall_base)
+        result.shard_count = int(getattr(backing, "node_shards", 1))
+        result.shard_tensor_rebuilds_total = int(
+            sum(metrics.shard_tensor_rebuilds._values.values())
+            - shard_rb_base)
+        result.shard_solve_seconds = \
+            sum(metrics.shard_solve_seconds._values.values()) - shard_s_base
+        result.cross_shard_reductions_total = int(
+            metrics.cross_shard_reductions.value() - xshard_base)
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

@@ -35,14 +35,30 @@ class SchedulerCache:
         self._pod_states: dict[str, dict] = {}
         self.assumed_pod_ttl = assumed_pod_ttl
         self._generation = 0
-        # Snapshot bookkeeping: cached NodeInfo clones by name + the
-        # generation they were copied at.
+        # Snapshot bookkeeping: cached NodeInfo clones by name.
         self._snap_nodes: dict[str, NodeInfo] = {}
-        self._snap_generation = -1
+        # Incremental-snapshot state (the 200k-preset host-prep fix):
+        # event handlers mark DIRTY node names; update_snapshot touches
+        # only those instead of walking all N nodes per cycle. The
+        # stable snapshot-order list + position map let Snapshot
+        # construction be pointer copies, and the (generation, index)
+        # changed-log hands ops/tensorize its O(changed) delta.
+        self._dirty: set[str] = set()
+        self._full = True            # first snapshot / node removal
+        self._snap_list: list[NodeInfo] = []
+        self._snap_pos: dict[str, int] = {}
+        self._aff_names: set[str] = set()
+        self._anti_names: set[str] = set()
+        self._set_epoch = 0          # bumps when the node set changes
+        self._spec_seq = 0           # bumps on any node OBJECT update
+        self._changed_log: list[tuple[int, int]] = []
+        self._log_floor = 0          # gens ≤ floor are out of the log
+        self._last_snap: Snapshot | None = None
 
     def _bump(self, node: NodeInfo) -> None:
         self._generation += 1
         node.generation = self._generation
+        self._dirty.add(node.name)
 
     # -- nodes -------------------------------------------------------------
 
@@ -54,6 +70,7 @@ class SchedulerCache:
             self.nodes[name] = ni
         else:
             ni.set_node(node)
+        self._spec_seq += 1  # node OBJECT changed: taints/alloc may move
         self._bump(ni)
 
     def update_node(self, node: Mapping) -> None:
@@ -63,7 +80,8 @@ class SchedulerCache:
         self.nodes.pop(name, None)
         self._snap_nodes.pop(name, None)
         self._generation += 1
-        self._snap_generation = -1  # force full re-snapshot on deletion
+        self._spec_seq += 1
+        self._full = True  # positions shift: full re-snapshot on deletion
 
     # -- pods --------------------------------------------------------------
 
@@ -175,18 +193,103 @@ class SchedulerCache:
 
     # -- snapshot ----------------------------------------------------------
 
+    def _clone_into_snap(self, name: str, ni: NodeInfo) -> None:
+        clone = ni.clone()
+        self._snap_nodes[name] = clone
+        pos = self._snap_pos.get(name)
+        if pos is None:
+            pos = self._snap_pos[name] = len(self._snap_list)
+            self._snap_list.append(clone)
+            self._set_epoch += 1  # node set grew: tensors re-key
+        else:
+            self._snap_list[pos] = clone
+            self._changed_log.append((clone.generation, pos))
+        if clone.pods_with_affinity:
+            self._aff_names.add(name)
+        else:
+            self._aff_names.discard(name)
+        if clone.pods_with_required_anti_affinity:
+            self._anti_names.add(name)
+        else:
+            self._anti_names.discard(name)
+
     def update_snapshot(self) -> Snapshot:
-        """Incremental snapshot: only nodes whose generation advanced since
-        the last snapshot are re-cloned (UpdateSnapshot's generation walk)."""
-        for name, ni in self.nodes.items():
-            cached = self._snap_nodes.get(name)
-            if cached is None or cached.generation != ni.generation:
-                self._snap_nodes[name] = ni.clone()
-        for name in list(self._snap_nodes):
-            if name not in self.nodes:
-                del self._snap_nodes[name]
-        self._snap_generation = self._generation
-        return Snapshot(list(self._snap_nodes.values()), self._generation)
+        """Incremental snapshot off the event stream: only DIRTY nodes
+        (marked by the informer/assume handlers' `_bump`) are re-cloned —
+        O(changed) per cycle, not UpdateSnapshot's O(N) generation walk,
+        which at the 200k preset cost more than the scheduling work it
+        fed. Node removals fall back to one full rebuild (positions
+        shift). The returned Snapshot carries the incremental host-prep
+        handles ops/tensorize consumes (set_epoch / spec_seq /
+        changed_since)."""
+        if not self._full and not self._dirty \
+                and self._last_snap is not None:
+            # Nothing moved since the last snapshot (generation can only
+            # advance through _bump/remove_node, which set dirty/_full):
+            # hand back the SAME immutable-by-convention snapshot — the
+            # scheduler re-snapshots ~10× per cycle and the no-op calls
+            # must not pay two O(N) copies each at 200k nodes.
+            return self._last_snap
+        if self._full:
+            self._snap_nodes = {}
+            self._snap_list = []
+            self._snap_pos = {}
+            self._aff_names = set()
+            self._anti_names = set()
+            self._changed_log = []
+            self._log_floor = self._generation
+            self._set_epoch += 1
+            for name, ni in self.nodes.items():
+                self._clone_into_snap(name, ni)
+            self._full = False
+            self._dirty.clear()
+        elif self._dirty:
+            for name in self._dirty:
+                ni = self.nodes.get(name)
+                if ni is None:
+                    continue  # removal already forced _full
+                cached = self._snap_nodes.get(name)
+                if cached is None or cached.generation != ni.generation:
+                    self._clone_into_snap(name, ni)
+            self._dirty.clear()
+            # Bound the log: once it outgrows the node set several times
+            # over, one full tensor re-scan is cheaper than carrying it.
+            if len(self._changed_log) > 4 * len(self._snap_list) + 65536:
+                self._changed_log = []
+                self._log_floor = self._generation
+        # Affinity lists in snapshot-position order (deterministic — the
+        # unsharded and sharded paths must build identical tables).
+        pos = self._snap_pos.get
+        snap = Snapshot(self._snap_list.copy(), self._generation,
+                        by_name=dict(self._snap_nodes),
+                        have_affinity=[self._snap_nodes[n] for n in
+                                       sorted(self._aff_names, key=pos)],
+                        have_anti_affinity=[self._snap_nodes[n] for n in
+                                            sorted(self._anti_names,
+                                                   key=pos)])
+        snap.set_epoch = self._set_epoch
+        snap.spec_seq = self._spec_seq
+        log, log_len, floor = self._changed_log, len(self._changed_log), \
+            self._log_floor
+
+        def changed_since(gen: int, _log=log, _n=log_len, _floor=floor):
+            """Snapshot-order indices changed after `gen`; None when the
+            window doesn't reach back that far (caller full-scans).
+            Entries are appended per update_snapshot batch, and every
+            batch's generations exceed the previous snapshot's, so a
+            back-scan terminates exactly at the boundary."""
+            if gen < _floor:
+                return None
+            out = set()
+            i = _n - 1
+            while i >= 0 and _log[i][0] > gen:
+                out.add(_log[i][1])
+                i -= 1
+            return out
+
+        snap.changed_since = changed_since
+        self._last_snap = snap
+        return snap
 
     def pod_count(self) -> int:
         return len(self._pod_states)

@@ -272,14 +272,32 @@ class Snapshot:
     """Immutable-by-convention view handed to a scheduling cycle
     (internal/cache/snapshot.go `Snapshot`)."""
 
-    def __init__(self, nodes: list[NodeInfo] | None = None, generation: int = 0):
+    def __init__(self, nodes: list[NodeInfo] | None = None, generation: int = 0,
+                 *, by_name: dict | None = None,
+                 have_affinity: list | None = None,
+                 have_anti_affinity: list | None = None):
         self.nodes = nodes or []
         self.generation = generation
-        self._by_name = {n.name: n for n in self.nodes}
-        self.have_pods_with_affinity = [n for n in self.nodes if n.pods_with_affinity]
-        self.have_pods_with_required_anti_affinity = [
-            n for n in self.nodes if n.pods_with_required_anti_affinity
-        ]
+        # The incremental cache passes its maintained structures (already
+        # consistent with `nodes`) so snapshot construction is O(changed),
+        # not three O(N) scans per cycle — the 200k-preset host-prep fix.
+        self._by_name = by_name if by_name is not None \
+            else {n.name: n for n in self.nodes}
+        self.have_pods_with_affinity = have_affinity \
+            if have_affinity is not None \
+            else [n for n in self.nodes if n.pods_with_affinity]
+        self.have_pods_with_required_anti_affinity = have_anti_affinity \
+            if have_anti_affinity is not None else [
+                n for n in self.nodes if n.pods_with_required_anti_affinity]
+        #: Incremental host-prep handles (set by SchedulerCache; the
+        #: defaults mean "unknown — do the full walk"): `set_epoch`
+        #: changes when the node SET/order changes, `spec_seq` when any
+        #: node object's spec changed, and `changed_since(gen)` returns
+        #: the snapshot-order indices of nodes whose generation advanced
+        #: past `gen` (None = outside the retained window).
+        self.set_epoch = -1
+        self.spec_seq = -1
+        self.changed_since = None
 
     def get(self, name: str) -> NodeInfo | None:
         return self._by_name.get(name)

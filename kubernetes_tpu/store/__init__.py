@@ -15,6 +15,12 @@ from kubernetes_tpu.store.mvcc import (
     binding_subresource,
     new_cluster_store,
 )
+from kubernetes_tpu.store.sharded import (
+    PARTITIONED_RESOURCES,
+    ShardedNodeStore,
+    control_plane_shards,
+    shard_of,
+)
 from kubernetes_tpu.store.apply import ApplyConflict, server_side_apply
 from kubernetes_tpu.store.durable import (
     DurabilityManager,
@@ -41,4 +47,8 @@ __all__ = [
     "binding_subresource",
     "new_cluster_store",
     "install_core_validation",
+    "PARTITIONED_RESOURCES",
+    "ShardedNodeStore",
+    "control_plane_shards",
+    "shard_of",
 ]

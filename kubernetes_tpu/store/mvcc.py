@@ -227,14 +227,34 @@ def _maybe_freeze(obj: dict) -> dict:
     return deep_freeze(obj) if _DEBUG_FREEZE else obj
 
 
+class RVCounter:
+    """Mutable ResourceVersion source. One per store by default; the
+    sharded control plane (store/sharded.py) hands ONE counter to all of
+    its per-shard stores, so RVs stay globally monotonic across shards —
+    a merged LIST's RV is resumable on every shard's watch, and pinned
+    continue tokens address one global snapshot whichever shard serves
+    the page (the etcd-revision-per-cluster contract, kept under
+    partitioning)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int = 0):
+        self.value = value
+
+    def next(self) -> int:
+        self.value += 1
+        return self.value
+
+
 class MVCCStore:
     """The store. One instance per "cluster"; resources are table names
     ("pods", "nodes", "events", ...) — the GVR analog."""
 
-    def __init__(self, event_window: int = DEFAULT_EVENT_WINDOW):
+    def __init__(self, event_window: int = DEFAULT_EVENT_WINDOW,
+                 rv_source: RVCounter | None = None):
         # resource -> key -> object (key = "ns/name" or "name")
         self._tables: dict[str, dict[str, dict]] = {}
-        self._rv = 0
+        self._rv_counter = rv_source or RVCounter()
         # Ring of (resource, Event) for watch replay.
         self._events: list[tuple[str, Event]] = []
         self._event_window = event_window
@@ -290,12 +310,19 @@ class MVCCStore:
         return self._tables.setdefault(resource, {})
 
     def _next_rv(self) -> int:
-        self._rv += 1
-        return self._rv
+        return self._rv_counter.next()
+
+    @property
+    def _rv(self) -> int:
+        return self._rv_counter.value
+
+    @_rv.setter
+    def _rv(self, value: int) -> None:
+        self._rv_counter.value = value
 
     @property
     def resource_version(self) -> int:
-        return self._rv
+        return self._rv_counter.value
 
     def _record(self, resource: str, ev: Event) -> None:
         self._events.append((resource, ev))
@@ -989,8 +1016,18 @@ async def binding_subresource(store: MVCCStore, key: str, binding: Mapping) -> d
     return {"kind": "Status", "apiVersion": "v1", "status": "Success"}
 
 
-def new_cluster_store() -> MVCCStore:
-    """Store with the core subresources registered."""
-    store = MVCCStore()
+def new_cluster_store(shards: int | None = None):
+    """Store with the core subresources registered. `shards > 1` builds
+    the partitioned control plane (store/sharded.py ShardedNodeStore:
+    node-keyed resources hash-partition across per-shard mvcc stores
+    under one global RV counter); None resolves the KTPU_SHARDS
+    override, default 1 — the classic single store."""
+    if shards is None:
+        shards = int(os.environ.get("KTPU_SHARDS", "1") or "1")
+    if shards > 1:
+        from kubernetes_tpu.store.sharded import ShardedNodeStore
+        store = ShardedNodeStore(shards)
+    else:
+        store = MVCCStore()
     store.register_subresource("pods", "binding", binding_subresource)
     return store

@@ -1,0 +1,134 @@
+"""Randomized sharded-vs-unsharded scheduling parity (ROADMAP #5).
+
+The sharded control plane reorganizes WHERE node state lives (per-shard
+stores, per-shard informer streams, per-shard host prep) but must not
+move a single assignment: the merged initial LIST hands both paths the
+same key-sorted node order, the shared RV counter keeps event order
+globally comparable, and the host prep's delta path rewrites rows in
+place — so the solver sees bit-identical tensors and the r10 stable
+index tie rule lands every pod on the same node. These tests run the
+same randomized workload through a single MVCCStore and through
+ShardedNodeStores at shard counts {1, 2, 4, 8} (1 = the structural
+degradation: `new_cluster_store(shards=1)` IS the single store) and
+require the assignment maps to be equal, not merely equivalent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.api.meta import namespaced_name
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.client import InformerFactory, ResourceEventHandler
+from kubernetes_tpu.metrics.registry import SchedulerMetrics
+from kubernetes_tpu.ops import TPUBackend
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+ZONES = ("a", "b", "c")
+
+
+def _random_cluster(seed: int, n_nodes: int = 48, n_pods: int = 96):
+    """Deterministic random workload: heterogeneous capacities, zone
+    labels, a fraction of selector-carrying pods. Total capacity is
+    plentiful so every pod schedules (pending pods would make the
+    comparison depend on when the watcher looks)."""
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append(dict(
+            name=f"n-{i:03d}",
+            allocatable={"cpu": str(rng.choice((4, 8, 16))),
+                         "memory": rng.choice(("16Gi", "32Gi", "64Gi")),
+                         "pods": "110"},
+            labels={"zone": rng.choice(ZONES)}))
+    pods = []
+    for i in range(n_pods):
+        spec = dict(
+            name=f"p-{i:03d}",
+            requests={"cpu": f"{rng.choice((100, 250, 500))}m",
+                      "memory": rng.choice(("128Mi", "256Mi", "512Mi"))})
+        if rng.random() < 0.3:
+            spec["node_selector"] = {"zone": rng.choice(ZONES)}
+        pods.append(spec)
+    return nodes, pods
+
+
+async def _schedule(store, nodes, pods, batch: int = 64) -> dict:
+    """Create nodes → sync informers (sorted initial LIST on every
+    path) → create pods → drain; returns {pod key: node name}."""
+    install_core_validation(store)
+    for spec in nodes:
+        await store.create("nodes", make_node(**spec))
+    metrics = SchedulerMetrics()
+    sched = Scheduler(store, seed=42, backend=TPUBackend(max_batch=batch),
+                      metrics=metrics)
+    factory = InformerFactory(store)
+    await sched.setup_informers(factory)
+    bound: dict[str, str] = {}
+
+    def track(obj):
+        node = obj.get("spec", {}).get("nodeName")
+        if node:
+            bound[namespaced_name(obj)] = node
+
+    factory.informer("pods").add_event_handler(ResourceEventHandler(
+        on_add=track, on_update=lambda old, new: track(new)))
+    factory.start()
+    await factory.wait_for_sync()
+    run_task = asyncio.ensure_future(sched.run(batch_size=batch))
+    try:
+        for spec in pods:
+            await store.create("pods", make_pod(**spec))
+        deadline = time.monotonic() + 60
+        while len(bound) < len(pods):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(bound)}/{len(pods)} pods bound")
+            await asyncio.sleep(0.01)
+    finally:
+        await sched.stop()
+        run_task.cancel()
+        factory.stop()
+        store.stop()
+    return dict(bound)
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_sharded_assignment_parity(seed):
+    async def go():
+        nodes, pods = _random_cluster(seed)
+        reference = await _schedule(new_cluster_store(), nodes, pods)
+        assert len(reference) == len(pods)
+        for shards in (1, 2, 4, 8):
+            got = await _schedule(
+                new_cluster_store(shards=shards), nodes, pods)
+            assert got == reference, (
+                f"shards={shards}: "
+                f"{sum(1 for k in got if got[k] != reference.get(k))} "
+                f"assignments diverged")
+    asyncio.run(go())
+
+
+def test_sharded_informer_is_active_in_parity_runs():
+    """The parity above must not pass because the sharded path silently
+    degraded: the node informer on a sharded store runs S shard loops."""
+    async def go():
+        nodes, pods = _random_cluster(5, n_nodes=24, n_pods=24)
+        store = new_cluster_store(shards=4)
+        install_core_validation(store)
+        for spec in nodes:
+            await store.create("nodes", make_node(**spec))
+        factory = InformerFactory(store)
+        inf = factory.informer("nodes")
+        inf.start()
+        await inf.wait_for_sync()
+        await asyncio.sleep(0.05)
+        assert getattr(inf, "_shard_count", 0) == 4
+        factory.stop()
+        store.stop()
+    asyncio.run(go())

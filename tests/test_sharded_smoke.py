@@ -1,0 +1,145 @@
+"""Tier-1 smoke for the sharded control plane (ROADMAP #5).
+
+Pins the activation contract (the 200k preset rides the sharded path;
+5k/50k keep the r12 single store bit-for-bit), the clean S=1
+degradation, the incremental host-prep delta build's exactness, and a
+small end-to-end run with every shard surface active (sharded store +
+per-shard informers + shard metrics + batched agent boot).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.store import MVCCStore, ShardedNodeStore, \
+    control_plane_shards, new_cluster_store
+
+
+def test_200k_preset_exists_and_activates_sharding(monkeypatch):
+    import bench
+    assert "200k" in bench.PRESETS
+    nodes, warmup, measured = bench.PRESETS["200k"]
+    assert nodes == 200_000 and measured >= 5000
+    monkeypatch.delenv("KTPU_SHARDS", raising=False)
+    monkeypatch.delenv("KTPU_SHARD_THRESHOLD", raising=False)
+    assert control_plane_shards(nodes) >= 2, \
+        "the 200k preset must ride the sharded path flagless"
+    # The 5k/50k guard presets stay single-store bit-for-bit.
+    assert control_plane_shards(bench.PRESETS["5k"][0]) == 1
+    assert control_plane_shards(bench.PRESETS["50k"][0]) == 1
+
+
+def test_degrades_cleanly_to_single_store():
+    s1 = new_cluster_store(shards=1)
+    assert isinstance(s1, MVCCStore) and not isinstance(
+        s1, ShardedNodeStore)
+    s8 = new_cluster_store(shards=8)
+    assert isinstance(s8, ShardedNodeStore) and s8.node_shards == 8
+    s8.stop()
+
+
+def test_incremental_tensor_delta_matches_full_build():
+    """The per-shard delta build (tensorize._init_delta) must produce
+    arrays bit-identical to a from-scratch build after binds mutate a
+    subset of nodes — the exactness contract that keeps sharded
+    assignments equal to unsharded ones."""
+    from kubernetes_tpu.ops.tensorize import ClusterTensors
+    cache = SchedulerCache()
+    for i in range(64):
+        cache.add_node(make_node(f"n-{i:02d}"))
+    snap0 = cache.update_snapshot()
+    ct0 = ClusterTensors(snap0)
+    assert ct0.shard_rebuilds, "first build rebuilds its shard(s)"
+    # Bind a few pods: only their nodes' rows may be rewritten.
+    for i, node in enumerate(("n-03", "n-17", "n-42")):
+        pod = make_pod(f"p-{i}", requests={"cpu": "500m",
+                                           "memory": "1Gi"})
+        pod["spec"]["nodeName"] = node
+        cache.add_pod(PodInfo(pod))
+    snap1 = cache.update_snapshot()
+    assert snap1.set_epoch == snap0.set_epoch
+    changed = snap1.changed_since(snap0.generation)
+    assert changed is not None and len(changed) == 3
+    delta = ClusterTensors(snap1, prev=ct0)
+    full = ClusterTensors(snap1)
+    np.testing.assert_array_equal(delta.used_q, full.used_q)
+    np.testing.assert_array_equal(delta.used_nz_q, full.used_nz_q)
+    np.testing.assert_array_equal(delta.used_pods, full.used_pods)
+    np.testing.assert_array_equal(delta.alloc_q, full.alloc_q)
+    assert delta.node_names == full.node_names
+    assert delta.node_gens == list(full.node_gens)
+    # Static pieces were SHARED, not rebuilt.
+    assert delta.alloc_q is ct0.alloc_q
+    assert delta.taint_filter_mat is ct0.taint_filter_mat
+
+
+def test_node_removal_falls_back_to_full_snapshot():
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(f"r-{i}"))
+    snap0 = cache.update_snapshot()
+    cache.remove_node("r-3")
+    snap1 = cache.update_snapshot()
+    assert len(snap1.nodes) == 7
+    assert snap1.set_epoch != snap0.set_epoch
+    assert snap1.changed_since(snap0.generation) is None, \
+        "positions shifted: consumers must full-rebuild"
+
+
+def test_sharded_e2e_with_agents_and_metrics():
+    """End-to-end at model scale: sharded store through the wire,
+    per-shard informers, batched agent fleet boot (NodeAgent.start_many
+    via the startAgents opcode), and the shard metrics populated in the
+    detail JSON."""
+    from kubernetes_tpu.ops import TPUBackend
+    from kubernetes_tpu.perf.scheduler_perf import PerfRunner
+
+    template = [
+        {"opcode": "startAgents", "count": 12},
+        {"opcode": "createNodes", "count": 52},
+        {"opcode": "createPods", "count": 40},
+        {"opcode": "barrier"},
+        {"opcode": "createPods", "count": 120, "collectMetrics": True},
+        {"opcode": "barrier"},
+    ]
+    runner = PerfRunner(backend=TPUBackend(max_batch=64), batch_size=256,
+                        through_apiserver="wire", shards=4)
+    res = asyncio.run(runner.run(template, {}, timeout=180.0))
+    d = res.as_dict()
+    assert d["scheduled_total"] == 160
+    assert d["shard_count"] == 4
+    assert d["shard_tensor_rebuilds_total"] > 0
+    assert d["cross_shard_reductions_total"] >= 120
+    assert d["agent_start_seconds"] > 0.0
+    assert d["shard_solve_seconds"] > 0.0
+
+
+def test_agent_start_many_batches_phases():
+    """start_many registers every agent's Node before any watch
+    establishment begins (two wide phases, not per-agent serialized
+    handshakes)."""
+    from kubernetes_tpu.agent import NodeAgent
+
+    async def go():
+        store = new_cluster_store(shards=2)
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            agents = [NodeAgent(store, f"a-{i}", checkpoint_dir=td,
+                                lease_period=30.0) for i in range(10)]
+            try:
+                await NodeAgent.start_many(agents, window=4)
+                lst = await store.list("nodes")
+                assert len(lst.items) == 10
+                # Partitioned across shards, not all on meta.
+                assert sum(1 for s in store.shards
+                           if s._table("nodes")) >= 2
+            finally:
+                for a in agents:
+                    await a.stop()
+                store.stop()
+    asyncio.run(go())

@@ -1,0 +1,337 @@
+"""ShardedNodeStore differential + semantics suite (ROADMAP #5).
+
+The sharded control plane must be INVISIBLE to correct clients: every
+read the facade serves — merged LISTs, pinned pagination, per-shard and
+multiplexed watches — is pinned bit-equal (same items, same order, same
+RV semantics) to a single MVCCStore fed the same writes. Randomized
+differential cases cover the merge paths; directed cases pin the
+routing, the shared-RV contract, and Expired behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.store import (
+    MVCCStore,
+    ShardedNodeStore,
+    control_plane_shards,
+    install_core_validation,
+    new_cluster_store,
+    shard_of,
+)
+from kubernetes_tpu.store.mvcc import Expired, NotFound
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _names(lst):
+    return [o["metadata"]["name"] for o in lst.items]
+
+
+async def _populated_pair(shards: int, n: int = 40, seed: int = 0):
+    rng = random.Random(seed)
+    plain, sharded = new_cluster_store(), ShardedNodeStore(shards)
+    names = [f"node-{rng.randrange(10_000)}-{i}" for i in range(n)]
+    for s in (plain, sharded):
+        for name in names:
+            await s.create("nodes", make_node(
+                name, labels={"bucket": str(hash(name) % 3)}))
+    return plain, sharded, names
+
+
+# -- construction / activation policy ------------------------------------
+
+
+def test_new_cluster_store_shards_param():
+    assert isinstance(new_cluster_store(), MVCCStore)
+    s = new_cluster_store(shards=4)
+    assert isinstance(s, ShardedNodeStore)
+    assert s.node_shards == 4
+    # S=1 degrades STRUCTURALLY: no facade at all.
+    assert isinstance(new_cluster_store(shards=1), MVCCStore)
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("KTPU_SHARDS", "3")
+    s = new_cluster_store()
+    assert isinstance(s, ShardedNodeStore) and s.node_shards == 3
+    monkeypatch.setenv("KTPU_SHARDS", "1")
+    assert isinstance(new_cluster_store(), MVCCStore)
+
+
+def test_control_plane_shards_policy(monkeypatch):
+    monkeypatch.delenv("KTPU_SHARDS", raising=False)
+    assert control_plane_shards(5_000) == 1
+    assert control_plane_shards(50_000) == 1
+    assert control_plane_shards(200_000) == 8
+    assert control_plane_shards(200_000, override=4) == 4
+    assert control_plane_shards(100, override=2) == 2
+    monkeypatch.setenv("KTPU_SHARDS", "6")
+    assert control_plane_shards(100) == 6
+    monkeypatch.setenv("KTPU_SHARD_THRESHOLD", "50")
+    monkeypatch.delenv("KTPU_SHARDS")
+    assert control_plane_shards(100) == 8
+
+
+def test_shard_of_stable_and_spread():
+    names = [f"node-{i}" for i in range(1000)]
+    ids = [shard_of(n, 8) for n in names]
+    assert ids == [shard_of(n, 8) for n in names]  # deterministic
+    for s in range(8):  # crc32 spreads template names reasonably
+        assert ids.count(s) > 50
+
+
+# -- routing --------------------------------------------------------------
+
+
+def test_partitioned_routing_and_meta():
+    async def go():
+        s = ShardedNodeStore(4)
+        await s.create("nodes", make_node("n-a"))
+        await s.create("pods", make_pod("p-a"))
+        # The node landed on exactly its hash shard; the pod on meta.
+        owner = shard_of("n-a", 4)
+        for i, shard in enumerate(s.shards):
+            has = "n-a" in shard._table("nodes")
+            assert has == (i == owner)
+        assert "default/p-a" in s.meta._table("pods")
+        # Reads route back.
+        assert (await s.get("nodes", "n-a"))["metadata"]["name"] == "n-a"
+        with pytest.raises(NotFound):
+            await s.get("nodes", "n-missing")
+        # guaranteed_update + delete route too.
+        def mut(o):
+            o["metadata"].setdefault("labels", {})["x"] = "1"
+            return o
+        got = await s.guaranteed_update("nodes", "n-a", mut)
+        assert got["metadata"]["labels"]["x"] == "1"
+        await s.delete("nodes", "n-a")
+        with pytest.raises(NotFound):
+            await s.get("nodes", "n-a")
+        s.stop()
+    run(go())
+
+
+def test_shared_rv_is_globally_monotonic():
+    async def go():
+        s = ShardedNodeStore(4)
+        rvs = []
+        for i in range(32):
+            obj = await s.create("nodes", make_node(f"n-{i}"))
+            rvs.append(int(obj["metadata"]["resourceVersion"]))
+        assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs)
+        assert s.resource_version == rvs[-1]
+        s.stop()
+    run(go())
+
+
+def test_binding_subresource_through_facade():
+    async def go():
+        s = new_cluster_store(shards=4)
+        install_core_validation(s)
+        await s.create("nodes", make_node("n-0"))
+        await s.create("pods", make_pod("p"))
+        out = await s.subresource("pods", "default/p", "binding",
+                                  {"target": {"name": "n-0"}})
+        assert out["status"] == "Success"
+        assert (await s.get("pods", "default/p"))["spec"]["nodeName"] \
+            == "n-0"
+        s.stop()
+    run(go())
+
+
+# -- differential: merged reads vs the single store -----------------------
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_merged_list_bit_equal(shards):
+    async def go():
+        plain, sharded, _ = await _populated_pair(shards, n=60,
+                                                  seed=shards)
+        lp = await plain.list("nodes")
+        ls = await sharded.list("nodes")
+        assert _names(lp) == _names(ls)
+
+        def strip_uid(o):
+            # uid is a process-global sequence and creationTimestamp is
+            # wall-clock seconds: both can differ between the two
+            # populations without any semantic divergence.
+            o = dict(o)
+            o["metadata"] = {k: v for k, v in o["metadata"].items()
+                             if k not in ("uid", "creationTimestamp")}
+            return o
+        assert [strip_uid(o) for o in lp.items] == \
+            [strip_uid(o) for o in ls.items]
+        assert ls.resource_version == sharded.resource_version
+        # Selector + fields filtering parity.
+        from kubernetes_tpu.api.labels import parse_selector
+        sel = parse_selector("bucket=1")
+        assert _names(await plain.list("nodes", selector=sel)) == \
+            _names(await sharded.list("nodes", selector=sel))
+        plain.stop(); sharded.stop()
+    run(go())
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_paginated_list_parity(shards):
+    async def go():
+        plain, sharded, _ = await _populated_pair(shards, n=53,
+                                                  seed=7 * shards)
+
+        async def pages(store, limit):
+            out, cont = [], None
+            while True:
+                r = await store.list("nodes", limit=limit,
+                                     continue_key=cont)
+                out.extend(_names(r))
+                cont = r.cont
+                if not cont:
+                    return out
+        for limit in (1, 7, 20, 60):
+            assert await pages(plain, limit) == await pages(sharded, limit)
+        plain.stop(); sharded.stop()
+    run(go())
+
+
+def test_pinned_pagination_spans_writes():
+    """A paginated LIST started before concurrent writes serves every
+    page at the FIRST page's snapshot RV — across shards, because the
+    shared RV counter makes the pin a global snapshot."""
+    async def go():
+        s = ShardedNodeStore(4)
+        names = sorted(f"n-{i:03d}" for i in range(30))
+        for n in names:
+            await s.create("nodes", make_node(n))
+        first = await s.list("nodes", limit=10)
+        assert first.cont
+        # Writes land between pages: adds, plus an update of a later key.
+        await s.create("nodes", make_node("a-before-everything"))
+        await s.guaranteed_update(
+            "nodes", names[-1],
+            lambda o: (o["metadata"].setdefault(
+                "labels", {}).update({"late": "1"}) or o))
+        got, cont = _names(first), first.cont
+        while cont:
+            r = await s.list("nodes", limit=10, continue_key=cont)
+            got.extend(_names(r))
+            for item in r.items:
+                assert "late" not in (
+                    item["metadata"].get("labels") or {}), \
+                    "page leaked post-snapshot state"
+            cont = r.cont
+        assert got == names  # the late add is not in the pinned LIST
+        s.stop()
+    run(go())
+
+
+# -- watches --------------------------------------------------------------
+
+
+def test_per_shard_watch_streams_partition_events():
+    async def go():
+        s = ShardedNodeStore(4)
+        seen: dict[int, list[str]] = {i: [] for i in range(4)}
+
+        async def consume(i, w):
+            async for ev in w:
+                if ev.type == "BOOKMARK":
+                    continue
+                seen[i].append(ev.object["metadata"]["name"])
+
+        watches = [await s.watch("nodes", shard=i) for i in range(4)]
+        tasks = [asyncio.ensure_future(consume(i, w))
+                 for i, w in enumerate(watches)]
+        names = [f"w-{i}" for i in range(24)]
+        for n in names:
+            await s.create("nodes", make_node(n))
+        await asyncio.sleep(0.1)
+        for i in range(4):
+            assert seen[i] == [n for n in names if shard_of(n, 4) == i]
+        for t in tasks:
+            t.cancel()
+        s.stop()
+    run(go())
+
+
+def test_multiplexed_watch_replay_and_live():
+    """The unsharded-client path (HTTP/gRPC wires): one merged stream
+    replays history from a global RV and then streams live events."""
+    async def go():
+        s = ShardedNodeStore(3)
+        for i in range(12):
+            await s.create("nodes", make_node(f"m-{i}"))
+        mark = s.resource_version
+        for i in range(12, 18):
+            await s.create("nodes", make_node(f"m-{i}"))
+        w = await s.watch("nodes", resource_version=mark)
+        got = []
+
+        async def consume():
+            async for ev in w:
+                if ev.type == "BOOKMARK":
+                    continue
+                got.append(ev.object["metadata"]["name"])
+                if len(got) >= 8:
+                    return
+        live = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.05)
+        await s.create("nodes", make_node("m-live-0"))
+        await s.create("nodes", make_node("m-live-1"))
+        await asyncio.wait_for(live, 5)
+        assert set(got) == {f"m-{i}" for i in range(12, 18)} | \
+            {"m-live-0", "m-live-1"}
+        # RVs in the merged stream are globally valid and > mark.
+        s.stop()
+    run(go())
+
+
+def test_watch_expired_parity():
+    async def go():
+        s = ShardedNodeStore(2)
+        await s.create("nodes", make_node("x-0"))
+        with pytest.raises(Expired):
+            await s.watch("nodes", resource_version=10_000, shard=0)
+        with pytest.raises(Expired):
+            await s.watch("nodes", resource_version=10_000)
+        s.stop()
+    run(go())
+
+
+def test_shared_observability_surfaces():
+    async def go():
+        s = ShardedNodeStore(4)
+        for i in range(8):
+            await s.create("nodes", make_node(f"o-{i}"))
+        # Every shard's cacher reports into ONE metrics object.
+        assert s.cacher is s.meta.cacher
+        for shard in s.shards:
+            assert shard.cacher.metrics is s.cacher.metrics
+            assert shard.watch_metrics is s.watch_metrics
+        h0 = s.cacher.metrics.hits.value()
+        await s.list("nodes")
+        assert s.cacher.metrics.hits.value() >= h0 + s.node_shards
+        assert isinstance(s.list_direct_total, dict)
+        s.stop()
+    run(go())
+
+
+def test_event_sinks_fan_to_all_shards():
+    async def go():
+        s = ShardedNodeStore(3)
+        events = []
+        s.add_event_sink(lambda res, ev: events.append(
+            (res, ev.object["metadata"]["name"])))
+        for i in range(9):
+            await s.create("nodes", make_node(f"sink-{i}"))
+        await s.create("pods", make_pod("sink-pod"))
+        assert len([e for e in events if e[0] == "nodes"]) == 9
+        assert ("pods", "sink-pod") in events
+        s.stop()
+    run(go())
