@@ -70,6 +70,12 @@ def main(argv=None) -> int:
                          "Default: flagless — the tuner derives K from the "
                          "chunk width and observed fallback rate, active "
                          "only when the node count dwarfs the scan width")
+    ap.add_argument("--class-pad", type=int, default=None,
+                    help="OVERRIDE the class-dictionary plane cap (max "
+                         "pod equivalence classes per chunk; 0 disables "
+                         "class planes entirely — the per-pod-plane "
+                         "before/after sweep knob). Default: flagless "
+                         "KTPU_CLASS_PAD (31)")
     ap.add_argument("--through-apiserver", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="cross the process boundary: workload writes, "
@@ -118,6 +124,16 @@ def main(argv=None) -> int:
         # Must land before the backend module reads it at import.
         import os
         os.environ["KTPU_SHORTLIST_K"] = str(args.shortlist_k)
+    if args.class_pad is not None:
+        import os
+        if args.class_pad <= 0:
+            os.environ["KTPU_CLASS_PLANES"] = "0"
+        else:
+            # Force the planes ON too: an inherited KTPU_CLASS_PLANES=0
+            # (a leftover kill-switch export) must not silently turn the
+            # advertised override into a per-pod-fallback run.
+            os.environ["KTPU_CLASS_PLANES"] = "1"
+            os.environ["KTPU_CLASS_PAD"] = str(args.class_pad)
 
     tracer = None
     if args.trace:

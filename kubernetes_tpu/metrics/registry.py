@@ -446,6 +446,29 @@ class SchedulerMetrics:
         #: so the label carries the shard COUNT the solve ran under,
         #: not a shard id), and the top-level cross-shard argmax
         #: reductions (one per pod step when S > 1).
+        #: Class-dictionary device-plane observability (r14): host prep
+        #: wall per chunk (the 200k bound the class planes attack — the
+        #: prep-vs-solve split per family), real pod-equivalence classes
+        #: behind the latest chunk's (C,N) planes (P on a per-pod
+        #: fallback), bytes of plane payloads actually device_put
+        #: (mask + score planes including cache fills, plus the per-chunk
+        #: class index / exception / rep-row pack), and pods that rode a
+        #: per-pod fallback because their chunk's distinct classes
+        #: overflowed KTPU_CLASS_PAD (the kill switch does NOT count —
+        #: only genuine class splits).
+        self.prep_duration = r.histogram(
+            "scheduler_tpu_prep_seconds",
+            "Host-side chunk prep wall time (rows, classes, uploads)")
+        self.plane_classes = r.gauge(
+            "scheduler_tpu_plane_classes_per_chunk",
+            "Pod equivalence classes behind the latest chunk's planes")
+        self.plane_bytes = r.counter(
+            "scheduler_tpu_plane_bytes_uploaded_total",
+            "Bytes of mask/score plane payloads uploaded to the device")
+        self.class_split_fallbacks = r.counter(
+            "scheduler_tpu_class_split_fallbacks_total",
+            "Pods solved through per-pod fallback planes after class "
+            "overflow")
         self.shard_tensor_rebuilds = r.counter(
             "scheduler_tpu_shard_tensor_rebuilds_total",
             "Host-prep tensor rebuilds per control-plane shard",

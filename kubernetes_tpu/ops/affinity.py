@@ -114,7 +114,12 @@ class AffinityCompiler:
         #: full-row caches keyed by pod CONTENT signature (namespace,
         #: labels, term list): template-stamped batches share one row —
         #: the per-pod O(N) row assembly was the 5k families' top host
-        #: cost. Cached rows are shared; callers must not mutate them.
+        #: cost. Cached rows are shared and IDENTITY-STABLE per
+        #: signature; callers must not mutate them. The backend's
+        #: class-dictionary build leans on that stability: its row
+        #: interning memoizes by object identity, so a template's
+        #: thousand pods hash the row bytes once and land in one device
+        #: plane class (ops/backend._prep_chunk).
         self._filter_row_cache: dict[tuple, np.ndarray] = {}
         self._score_row_cache: dict[tuple, np.ndarray] = {}
 

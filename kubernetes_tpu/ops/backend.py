@@ -3,8 +3,10 @@
 North-star seam (BASELINE.json): the reference's per-pod
 `findNodesThatFitPod` / `prioritizeNodes` 16-goroutine fan-out
 (pkg/scheduler/framework/parallelize/parallelism.go, schedule_one.go) becomes
-one XLA program over a `(P pending pods × N nodes)` mask/score tensor plus a
-batched assignment solve (ops/solver.py). The plugin contract is preserved:
+one XLA program over `(C classes × N nodes)` class-dictionary mask/score
+planes (pods dedupe into C equivalence classes; a `(P,)` index maps pods to
+rows) plus a batched assignment solve (ops/solver.py). The plugin contract
+is preserved:
 
 - Plugins with device kernels (ops/kernels.py) — NodeResourcesFit,
   NodeResourcesBalancedAllocation, TaintToleration — run fully on device.
@@ -18,10 +20,22 @@ batched assignment solve (ops/solver.py). The plugin contract is preserved:
   namespace sets at table-build time) into dense rows over interned label
   signatures, and PodTopologySpread rides the union scan table
   (heterogeneous templates, minDomains, restricted node eligibility,
-  non-self-matching selectors). Host score planes ship as a row
-  DICTIONARY (distinct per-signature rows + per-pod index, gathered on
-  device) whenever a chunk has few distinct rows — the (P,N) dense plane
-  upload was the relay-attached families' dominant cost.
+  non-self-matching selectors).
+- Device planes are CLASS-DICTIONARY native: pods dedupe into
+  equivalence classes keyed by (request row, toleration row, host
+  filter-row signatures, score-row signatures), and the wire ships one
+  (C, N/8) bit-packed mask plane + one (C, N) float16 static-score
+  plane + a (P,) int32 class index — never a per-pod (P, N) plane, on
+  host OR device (the fused program computes fit/taint/score planes at
+  class level and every solver scan gathers `class_idx[pod]` per step).
+  Template batches have a handful of classes, so per-chunk plane work
+  is O(C·N) ≈ chunk/C smaller than the per-pod format this replaces
+  (and the r7 row-dictionary score wire is subsumed by it). Single-
+  allowed-column host rows (NodeName, DRA allocated-claim pins) ride a
+  sparse per-pod exception column instead of splitting a class. A chunk
+  with more classes than KTPU_CLASS_PAD — or KTPU_CLASS_PLANES=0 —
+  degrades structurally to per-pod planes (C == P, identity index),
+  counted as class_split_fallbacks.
 - The remaining per-pod host rows (NodePorts conflicts, volume plugins,
   DRA shapes the tensors can't answer) are Skip-gated per pod and COUNTED
   (kind="host_fallback"; bench detail `host_fallback_pods`) — residency
@@ -98,21 +112,42 @@ _DEFAULT_CHUNK = 1024
 _SHORTLIST_K_OVERRIDE = int(os.environ["KTPU_SHORTLIST_K"]) \
     if os.environ.get("KTPU_SHORTLIST_K") else None
 
-#: Shortlist class slots per chunk (jit-stable pad). Pods sharing
-#: (request row, toleration row, mask row, score-dictionary row) share a
-#: chunk-start score row — template batches have a handful of classes, so
-#: the prefilter computes S rows instead of P. A chunk with more distinct
-#: classes than this keeps the full N-wide scan (counted via scan width).
-SHORTLIST_CLASS_PAD = 8
+#: Class-dictionary plane cap: the maximum number of REAL pod
+#: equivalence classes per chunk (plane row 0 is reserved for the empty
+#: class — padding pods, unknown-resource pods, conflicting pins — so
+#: plane rows ≤ KTPU_CLASS_PAD + 1, bucketed to the next power of two
+#: for a stable jit signature). Pods share a class when they share
+#: (request row, toleration row, host filter-row set, score-row parts);
+#: template batches have a handful, so the planes are (C, N) with
+#: C ≪ chunk — a 1024-pod chunk at 50k nodes ships ~2 class rows
+#: (~25 KB) where the per-pod format shipped a 6.4 MB packed mask and
+#: materialized a 100+ MB score plane on device. A chunk with more
+#: classes than this cap — or KTPU_CLASS_PLANES=0 — falls back to
+#: per-pod planes (C == P, identity index): structurally the pre-class
+#: dense format, bit-identical assignments, counted per pod as
+#: class_split_fallbacks.
+DEFAULT_CLASS_PAD = 31
 
-#: Row-dictionary score wire width: when every host score contribution in
-#: a chunk comes from ≤ SCORE_ROWS_PAD-1 distinct per-signature rows
-#: (template batches — the constraint families' normal case), the wire
-#: ships (SCORE_ROWS_PAD, N) rows + a (P,) index instead of the dense
-#: (P, N) plane and the device gathers. A 2048×5120 f16 plane is ~20 MB
-#: per chunk — at the relay's ~12 MB/s that upload ALONE capped the
-#: affinity families; the dictionary is ~80 KB. Row 0 is reserved zero.
-SCORE_ROWS_PAD = 8
+
+def class_pad() -> int:
+    """Effective class cap: 0 = class planes off (per-pod fallback).
+    Read per assign() so tests/bench can flip the env knobs live."""
+    if os.environ.get("KTPU_CLASS_PLANES", "1") in ("0", "false", "False"):
+        return 0
+    try:
+        return max(0, int(os.environ.get("KTPU_CLASS_PAD",
+                                         str(DEFAULT_CLASS_PAD))))
+    except ValueError:
+        return DEFAULT_CLASS_PAD
+
+
+def _class_rows_bucket(n_classes: int) -> int:
+    """Plane row count for n_classes real classes + the reserved empty
+    row 0, bucketed to a power of two (≥ 2) so jit signatures repeat."""
+    rows = 2
+    while rows < n_classes + 1:
+        rows <<= 1
+    return rows
 
 
 class AdaptiveTuner:
@@ -125,10 +160,11 @@ class AdaptiveTuner:
       trips at first assign. Separates a relay-attached accelerator
       (~25–100 ms per transfer regardless of size) from a locally
       attached device (sub-millisecond).
-    - **dirty-upload ratio**: fraction of prepped chunks whose (P,N)
-      mask/score planes were host-written and re-uploaded — the signature
-      of constraint families (affinity/spread host rows), which favor
-      smaller chunks so the bit-packed uploads pipeline against solves.
+    - **dirty-upload ratio**: fraction of prepped chunks whose (C,N)
+      class mask/score planes were host-written and re-uploaded — the
+      signature of constraint families (affinity/spread host rows), which
+      favor smaller chunks so the bit-packed uploads pipeline against
+      solves.
 
     Policy (BASELINE.md r6 "adaptive vs manual" table is the recorded
     envelope; tests/test_tpu_backend.py + tests/test_shortlist_smoke.py
@@ -148,12 +184,15 @@ class AdaptiveTuner:
     ~2-transfer pipeline bubble. Local: there is no round trip to
     amortize — 1024 measured best and stable on both clean and dirty
     families (r6 sweep) — and depth beyond 2 just delays verify feedback.
-    The r6 table was tuned on the ≤5k presets; the large-N row (r10)
-    pins the regime the 50k sweep measured: the shortlist scan width is
+    The r6 table was tuned on the ≤5k presets; the large-N row pins the
+    regime the 50k sweeps measured: the shortlist scan width is
     K+P = 2·chunk, so widening the chunk COSTS scan work faster than it
-    amortizes the per-chunk O(N) fixed costs (prefilter + top-k, (P,N)
-    static-score materialization, mask unpack) — 1024 beat both 2048 and
-    512 at N=50k on the CPU container (BASELINE r10). Node count is
+    amortizes the per-chunk fixed costs — and the r14 class-dictionary
+    planes cut those fixed costs from O(P·N) to O(C·N) (prefilter,
+    score materialization, and mask unpack all run over C class rows),
+    which the r14 re-sweep confirmed does NOT move the optimum: 1024
+    still beat 2048 and 512 at N=50k (BASELINE r10 pre-class, r14
+    post-class). Node count is
     STRUCTURAL (known at the first assign), so unlike the measured
     signals this row applies without waiting out the warmup window — the
     50k preset's chunk and shortlist compile in warmup, never in a
@@ -375,15 +414,15 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
 
 
 @partial(jax.jit, static_argnames=("strategy", "use_spread", "shortlist_k"))
-def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
-                       taint_f_mat, taint_p_mat, static_mask, host_scores,
-                       score_rows, score_idx,
+def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
+                       cls_idx, exc_col,
+                       taint_f_mat, taint_p_mat, class_mask, class_scores,
                        fit_col_w, bal_col_mask, shape_u, shape_s,
                        w_fit, w_bal, w_taint, taint_filter_on,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
                        sp_min_ok, sp_haskey,
                        sp_applies, sp_contrib, perms, gang_onehot,
-                       gang_required, sl_reps, sl_class,
+                       gang_required,
                        strategy: str, use_spread: bool, shortlist_k: int):
     """One fused device pass: plugin masks → scores → assignment → state.
 
@@ -397,12 +436,30 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     (quantized-conservative integers), so the chain is as correct as
     re-uploading from the host.
 
-    pod_pack is (P, 2R+tf+tp) int32: req_q ‖ req_nz_q ‖ untol_f ‖ untol_p.
+    CLASS-DICTIONARY planes (the native format — see _prep_chunk):
+
+    - class_mask: (C, N/8) uint8 bit-packed host filter rows per pod
+      equivalence class (row 0 = the reserved EMPTY class).
+    - class_scores: (C, N) f16/f32 host score rows per class.
+    - class_pack: (C, 2R+tf+tp) int32 — req_q ‖ req_nz_q ‖ untol_f ‖
+      untol_p of each class's representative pod (identical across the
+      class by the class key).
+    - cls_idx: (P,) int32 pod → class row; exc_col: (P,) int32 — the
+      sparse exception list: -1 = none, else the ONE column the pod is
+      additionally restricted to (single-allowed-column host rows ride
+      here instead of splitting a class).
+
+    Every O(N) plane — mask unpack, fit/taint filter, taint score,
+    chunk-start prefilter — is computed over C class rows, never P pod
+    rows; the scans gather `cls_idx[pod]` per step (ops/solver.py
+    `rows=`), so no (P, N) array exists anywhere in the program. The
+    per-pod degenerate form (C == P, cls_idx == arange, the
+    KTPU_CLASS_PLANES=0 kill switch / class-overflow fallback) runs the
+    SAME program and is bit-identical by construction.
 
     shortlist_k > 0 switches the solve to the SHORTLIST-PRUNED scans
-    (ops/solver.py): a prefilter computes chunk-start live scores for the
-    chunk's SHORTLIST_CLASS_PAD pod classes (sl_reps = representative pod
-    per class, sl_class = per-pod class index), takes the per-class top-K
+    (ops/solver.py): the prefilter computes chunk-start live scores per
+    CLASS directly off the class planes, takes the per-class top-K
     columns plus the (K+1)-th value as exactness threshold, and the scan
     re-scores K + P candidate columns per step instead of N — falling
     back to the full row exactly when the bound check cannot prove the
@@ -411,61 +468,73 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
     guard).
 
     Returns (assign (P+1,) — last element is the chunk's fallback count —
-    used_pack', fit0 (P,N), taint_ok (P,N), dom_counts').
+    used_pack', fit0 (C,N), taint_ok (C,N), dom_counts'). The diagnostic
+    planes are CLASS-level; consumers gather through cls_idx host-side.
     """
     # Wire decompression (see _prep_chunk): masks arrive bit-packed
-    # uint8 (P, N/8) big-endian, scores float16 — unpack/cast on device
+    # uint8 (C, N/8) big-endian, scores float16 — unpack/cast on device
     # where the FLOPs are free and the relay bytes are not.
     shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
-    static_mask = ((static_mask[:, :, None] >> shifts) & 1).reshape(
-        static_mask.shape[0], -1).astype(jnp.bool_)[:, : alloc_q.shape[0]]
-    # Host scores = dense plane + row-dictionary gather (row 0 is zero,
-    # so the unused side of either path contributes nothing).
-    host_scores = host_scores.astype(jnp.float32) \
-        + score_rows.astype(jnp.float32)[score_idx]
+    cmask = ((class_mask[:, :, None] >> shifts) & 1).reshape(
+        class_mask.shape[0], -1).astype(jnp.bool_)[:, : alloc_q.shape[0]]
+    host_scores = class_scores.astype(jnp.float32)
 
     r = alloc_q.shape[1]
     tf = taint_f_mat.shape[1]
     used_q = used_pack[:, :r]
     used_nz_q = used_pack[:, r:2 * r]
     used_pods = used_pack[:, 2 * r]
-    req_q = pod_pack[:, :r]
-    req_nz_q = pod_pack[:, r:2 * r]
-    untol_f = pod_pack[:, 2 * r:2 * r + tf].astype(jnp.bool_)
-    untol_p = pod_pack[:, 2 * r + tf:].astype(jnp.bool_)
+    c_req_q = class_pack[:, :r]
+    c_req_nz_q = class_pack[:, r:2 * r]
+    c_untol_f = class_pack[:, 2 * r:2 * r + tf].astype(jnp.bool_)
+    c_untol_p = class_pack[:, 2 * r + tf:].astype(jnp.bool_)
+    # Per-pod request rows are class gathers (tiny: (P,R)); the scans
+    # debit with them while every plane stays (C,N).
+    req_q = c_req_q[cls_idx]
+    req_nz_q = c_req_nz_q[cls_idx]
 
-    fit0 = kernels.fit_filter_mask(alloc_q, used_q, used_pods, alloc_pods, req_q)
-    taint_ok = kernels.taint_filter_mask(taint_f_mat, untol_f)
+    fit0 = kernels.fit_filter_mask(
+        alloc_q, used_q, used_pods, alloc_pods, c_req_q)        # (C,N)
+    taint_ok = kernels.taint_filter_mask(taint_f_mat, c_untol_f)
     taint_ok = taint_ok | jnp.logical_not(taint_filter_on)
-    mask = static_mask & taint_ok
+    mask = cmask & taint_ok
     feasible = mask & fit0
 
     # Capacity-independent score components; the capacity-dependent plugins
-    # (fit/balanced) are re-scored live inside the greedy scan.
+    # (fit/balanced) are re-scored live inside the greedy scan. Taint
+    # normalization runs over the CLASS feasible set: exception-pinned
+    # pods keep their class row (their argmax ranges over one column, so
+    # scores cannot change their assignment).
     static_scores = host_scores + w_taint * kernels.taint_toleration_score(
-        taint_p_mat, untol_p, feasible)
+        taint_p_mat, c_untol_p, feasible)
 
     free_q = alloc_q - used_q
     free_pods = alloc_pods - used_pods
     dom_counts2 = dom_counts
     nfall = jnp.int32(0)
+    n_pad = alloc_q.shape[0]
     if shortlist_k:
         # Shortlist prefilter: chunk-start live scores per pod CLASS
-        # (S rows, not P — template batches share rows), top-K columns +
-        # the (K+1)-th value as the scans' exactness threshold. Chunk-
-        # start capacity feasibility folds in (capacity only decreases
-        # within a chunk); spread gating deliberately does not (it is
-        # non-monotone and exact in-scan — see the spread solver).
+        # (C rows, not P — the planes already ARE class rows), top-K
+        # columns + the (K+1)-th value as the scans' exactness
+        # threshold. Chunk-start capacity feasibility folds in (capacity
+        # only decreases within a chunk); spread gating deliberately
+        # does not (it is non-monotone and exact in-scan — see the
+        # spread solver).
         sc0 = kernels.chunk_start_scores(
-            alloc_q, used_nz_q, req_nz_q[sl_reps], static_scores[sl_reps],
+            alloc_q, used_nz_q, c_req_nz_q, static_scores,
             fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
             strategy)
-        rep_feas = mask[sl_reps] & fit0[sl_reps]
         cand_s, thresh_s = solver.shortlist_prefilter(
-            rep_feas, sc0, shortlist_k)
-        sl_cand = cand_s[sl_class]                              # (P, K)
-        sl_thresh = thresh_s[sl_class]                          # (P,)
-        has_node = jnp.any(mask, axis=1)                        # (P,)
+            feasible, sc0, shortlist_k)
+        sl_cand = cand_s[cls_idx]                               # (P, K)
+        sl_thresh = thresh_s[cls_idx]                           # (P,)
+        # has_node: class-level any(), narrowed to the pinned column for
+        # exception pods (their only possibly-feasible node).
+        has_c = jnp.any(mask, axis=1)                           # (C,)
+        has_node = has_c[cls_idx]
+        safe_e = jnp.clip(exc_col, 0, n_pad - 1)
+        has_node = jnp.where(exc_col >= 0, mask[cls_idx, safe_e], has_node)
     if use_spread:
         # Spread batches run the identity order only (domain counts and
         # permutations don't commute cheaply); gang masking still applies.
@@ -477,14 +546,16 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                     shape_s, w_fit, w_bal, strategy,
                     dom_onehot, cid_onehot, dom_counts, max_skew,
                     sp_min_ok, sp_haskey, sp_applies, sp_contrib,
-                    sc0, sl_class, sl_cand, sl_thresh, has_node)
+                    sc0, cls_idx, sl_cand, sl_thresh, has_node,
+                    rows=cls_idx, exc=exc_col)
         else:
             a0, dom_counts2 = solver.greedy_assign_rescoring_spread(
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
                 static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
                 w_fit, w_bal, strategy,
                 dom_onehot, cid_onehot, dom_counts, max_skew,
-                sp_min_ok, sp_haskey, sp_applies, sp_contrib)
+                sp_min_ok, sp_haskey, sp_applies, sp_contrib,
+                rows=cls_idx, exc=exc_col)
         assign = solver.gang_filter(a0, gang_onehot, gang_required)
         # Gang-dropped pods bumped the chained counts in-scan (for the
         # constraints they CONTRIBUTE to) — fold them back out so later
@@ -501,12 +572,14 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, pod_pack,
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
                 mask, static_scores, fit_col_w, bal_col_mask, shape_u,
                 shape_s, w_fit, w_bal, strategy, perms, gang_onehot,
-                gang_required, sc0, sl_class, sl_cand, sl_thresh, has_node)
+                gang_required, sc0, cls_idx, sl_cand, sl_thresh, has_node,
+                rows=cls_idx, exc=exc_col)
         else:
             assign = solver.multistart_greedy_assign(
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
                 static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
-                w_fit, w_bal, strategy, perms, gang_onehot, gang_required)
+                w_fit, w_bal, strategy, perms, gang_onehot, gang_required,
+                rows=cls_idx, exc=exc_col)
 
     # Post-assignment state update (scatter-add of assigned requests).
     # Padding/unassigned rows scatter to a dummy row (index N, dropped).
@@ -598,13 +671,12 @@ class TPUBackend:
             tuple[str, str], tuple[np.ndarray, bool]] = {}
         self._row_fp: tuple | None = None
         # Device-resident constants for the common "no host rows" case:
-        # uploading a (P,N) bool+f32 pair every batch (~6.5 MB at 5k nodes)
-        # dominates wall-clock on a remote-attached TPU. Keyed by shape.
+        # clean chunks' class planes depend only on (plane rows, real
+        # classes, node count), so one cached (C,N/8)+(C,N) pair serves
+        # every clean chunk of that shape — the per-pod fallback's
+        # (P,N)-shaped equivalents ride the same dicts.
         self._dev_base_mask: dict[tuple, object] = {}
         self._dev_zero_scores: dict[tuple, object] = {}
-        #: zero (row-dictionary, index) pair for chunks with no
-        #: dictionary-form scores (see SCORE_ROWS_PAD).
-        self._dev_zero_srows: dict[tuple, tuple] = {}
         # Static per-snapshot arrays (alloc, taints) re-uploaded only when
         # the node-static fingerprint moves.
         self._dev_static: dict[str, object] = {}
@@ -628,9 +700,11 @@ class TPUBackend:
         # host→device transfer costs relay latency regardless of size.
         self._dev_perms_cache: dict[tuple, object] = {}
         self._dev_zero_gang: dict[int, tuple] = {}
-        #: zero (sl_reps, sl_class) pair for chunks solved without the
-        #: shortlist (the jit signature keeps the slots either way).
-        self._dev_zero_sl: dict[int, tuple] = {}
+        #: cached identity class index / no-exception vectors for the
+        #: per-pod fallback and clean class chunks (tiny, but uploaded
+        #: per chunk otherwise).
+        self._dev_arange: dict[int, object] = {}
+        self._dev_no_exc: dict[int, object] = {}
 
     # -- device placement ----------------------------------------------------
 
@@ -1383,6 +1457,9 @@ class TPUBackend:
         self._ns_resolver = getattr(src, "ns_resolver", None)
         ctx = _AssignCtx()
         ctx.snapshot, ctx.fwk, ctx.ct = snapshot, fwk, ct
+        # Class-plane cap resolved once per assign() (env-driven so tests
+        # and the bench --class-pad sweep can flip it between calls).
+        ctx.class_pad = class_pad()
         ctx.chunks = [pods[lo:lo + self.max_batch]
                       for lo in range(0, len(pods), self.max_batch)]
         ctx.assignments, ctx.diagnostics = {}, {}
@@ -1489,6 +1566,7 @@ class TPUBackend:
         return params
 
     def _prep_chunk(self, pods: list[PodInfo], ctx: "_AssignCtx") -> dict:
+        prep_t0 = time.perf_counter()
         ct, snapshot, fwk = ctx.ct, ctx.snapshot, ctx.fwk
         ctx.chunk_seq += 1
         chunk_idx = ctx.chunk_seq
@@ -1499,29 +1577,43 @@ class TPUBackend:
         filter_names = {p.NAME for p in fwk.filter_plugins}
         score_plugins = {p.NAME: p for p in fwk.score_plugins}
 
-        # Base mask: real pods × valid nodes. LAZY copy-on-write: the
-        # pristine (P,N) block pattern is 40+ MB at 8k×5k — allocating and
-        # zeroing it per chunk costs more than most chunks' entire host
-        # work, so it materializes only when a plugin actually writes a
-        # row; the unmodified case reuses a cached device array.
-        base_key = (P, N, batch.p_real, ct.n_real)
-        static_mask: np.ndarray | None = None
-        mask_modified = False
+        # Host filter rows accumulate as INTERNED references, never a
+        # (P,N) plane: each applied row is content-interned once (shared
+        # row objects — the static/IPA caches — memoize by identity, so
+        # the O(N) tobytes runs once per distinct row, not per pod), each
+        # pod carries the list of its row ids, and the class build below
+        # materializes ONE AND-folded row per distinct row-set. Rows with
+        # exactly one allowed column (NodeName, DRA allocated-claim pins)
+        # become the pod's sparse EXCEPTION column instead — they would
+        # otherwise split every pinned pod into its own class.
+        row_store: dict[int, np.ndarray] = {}      # cid -> ok row (n_real,)
+        _row_bytes: dict[bytes, int] = {}
+        _row_memo: dict[int, tuple] = {}           # id(row) -> (cid,nnz,col)
+        _row_refs: list = []                       # pin ids against reuse
+        pod_rows: dict[int, list[int]] = {}        # pod -> ordered cids
+        pod_pin: dict[int, int] = {}               # pod -> exception column
+        infeasible: set[int] = set()               # empty mask (class 0)
 
-        def _get_mask() -> np.ndarray:
-            nonlocal static_mask, mask_modified
-            if static_mask is None:
-                static_mask = np.zeros((P, N), dtype=np.bool_)
-                static_mask[: batch.p_real, : ct.n_real] = True
-                mask_modified = True
-            return static_mask
+        def _intern_row(row: np.ndarray) -> tuple:
+            got = _row_memo.get(id(row))
+            if got is None:
+                b = row.tobytes()
+                cid = _row_bytes.get(b)
+                if cid is None:
+                    cid = _row_bytes[b] = len(_row_bytes)
+                    row_store[cid] = row
+                nnz = int(row.sum())
+                col = int(np.argmax(row)) if nnz == 1 else -1
+                got = _row_memo[id(row)] = (cid, nnz, col)
+                _row_refs.append(row)
+            return got
 
         # Pods requesting resources no tracked column covers are infeasible
         # everywhere (would silently drop a constraint on device).
         unknown_res: set[int] = set()
         for i, pi in enumerate(pods):
             if ct.has_unknown_resource(pi.requests):
-                _get_mask()[i, :] = False
+                infeasible.add(i)
                 unknown_res.add(i)
 
         # Host-side rows: static predicate plugins (signature-cached) and
@@ -1532,7 +1624,9 @@ class TPUBackend:
         #: hard-spread pods deferred for template detection (see
         #: _process_spread_pods): (chunk index, PodInfo, constraints).
         spread_pods: list[tuple[int, PodInfo, list[dict]]] = []
-        host_filter_fail: dict[str, np.ndarray] = {}  # plugin -> (P,N) ok-mask
+        #: plugin -> {pod -> its (n_real,) ok row} for the lazy per-pod
+        #: diagnostics (shared row objects — no plane, no copies).
+        host_filter_fail: dict[str, dict[int, np.ndarray]] = {}
         #: pods whose NON-affinity stateful filter gate fired (full host
         #: re-verification). Affinity-handled pods are covered by the cheap
         #: delta verify inside _verify (routed by delta_has_terms /
@@ -1543,17 +1637,33 @@ class TPUBackend:
         #: backend_degradations{kind="host_fallback"} below.
         fallback_pods: set[int] = set()
 
+        def _apply_interned(i: int, cid: int, nnz: int, col: int) -> None:
+            if nnz == 0:
+                infeasible.add(i)
+            elif col >= 0:
+                prev = pod_pin.get(i)
+                if prev is None:
+                    pod_pin[i] = col
+                elif prev != col:    # two pins disagree: no node survives
+                    infeasible.add(i)
+            else:
+                lst = pod_rows.get(i)
+                if lst is None:
+                    lst = pod_rows[i] = []
+                if cid not in lst:
+                    lst.append(cid)
+
         def apply_row(pname: str, i: int, row: np.ndarray) -> None:
-            # All-true rows are no-ops; applying them would dirty the mask
-            # and force a (P,N) re-upload every batch — the relay-attached
-            # TPU's dominant cost (~0.3 s per 2.6 MB mask at 5k nodes).
+            # All-true rows are no-ops; applying them would dirty the
+            # planes and force a re-upload every batch.
             if row.all():
                 return
-            ok = host_filter_fail.get(pname)
-            if ok is None:  # setdefault would allocate the array per call
-                ok = host_filter_fail[pname] = np.ones((P, N), dtype=np.bool_)
-            ok[i, : ct.n_real] &= row
-            _get_mask()[i, : ct.n_real] &= row
+            fmap = host_filter_fail.get(pname)
+            if fmap is None:
+                fmap = host_filter_fail[pname] = {}
+            prev = fmap.get(i)
+            fmap[i] = row if prev is None else (prev & row)
+            _apply_interned(i, *_intern_row(row))
 
         #: shared-row groups for the tensorized InterPodAffinity rows:
         #: template batches produce ONE row object per signature, so the
@@ -1648,13 +1758,16 @@ class TPUBackend:
             row = row_full[: ct.n_real]
             if row.all():
                 continue
-            ok = host_filter_fail.get("InterPodAffinity")
-            if ok is None:
-                ok = host_filter_fail["InterPodAffinity"] = np.ones(
-                    (P, N), dtype=np.bool_)
-            idx = np.asarray(idxs, dtype=np.intp)[:, None]
-            ok[idx, : ct.n_real] &= row[None, :]
-            _get_mask()[idx, : ct.n_real] &= row[None, :]
+            # One interned row per signature group — every member pod
+            # references it (class sharing falls out of the shared cid).
+            cid, nnz, col = _intern_row(row)
+            fmap = host_filter_fail.get("InterPodAffinity")
+            if fmap is None:
+                fmap = host_filter_fail["InterPodAffinity"] = {}
+            for i in idxs:
+                prev = fmap.get(i)
+                fmap[i] = row if prev is None else (prev & row)
+                _apply_interned(i, cid, nnz, col)
 
         spread_active_idx = self._process_spread_pods(
             spread_pods, pods, ctx, snapshot, ct, apply_row, stateful_pods,
@@ -1699,38 +1812,45 @@ class TPUBackend:
         # here must match the full Filter outcome — static rows ∧ taints ∧
         # exact fit — or min-max normalizations get skewed by scores of
         # nodes the solver will mask anyway.
-        # Same lazy treatment: the (P,N) float32 plane is ~170 MB at
-        # 8k×5k; zeroing it per chunk dwarfs the basic families' host work.
-        host_scores: np.ndarray | None = None
-        scores_modified = False
+        # Scores accumulate as interned PARTS, mirroring the filter rows:
+        # each contribution is one (n_real,) float32 row shared by every
+        # pod of the signature, each pod carries its ordered part list,
+        # and the class build sums parts once per class — the per-pod
+        # (P,N) float32 plane (~170 MB at 8k×5k) never exists.
+        score_store: dict[int, np.ndarray] = {}    # sid -> weighted row
+        _score_bytes: dict[bytes, int] = {}
+        pod_parts: dict[int, list[int]] = {}       # pod -> ordered sids
         fit_np: np.ndarray | None = None
         taint_np: np.ndarray | None = None
 
-        def _get_scores() -> np.ndarray:
-            nonlocal host_scores, scores_modified
-            if host_scores is None:
-                host_scores = np.zeros((P, N), dtype=np.float32)
-                scores_modified = True
-            return host_scores
+        def _intern_score(row: np.ndarray) -> int:
+            b = row.tobytes()
+            sid = _score_bytes.get(b)
+            if sid is None:
+                sid = _score_bytes[b] = len(_score_bytes)
+                score_store[sid] = row
+            return sid
+
+        def add_score_row(i: int, row: np.ndarray) -> None:
+            pod_parts.setdefault(i, []).append(_intern_score(row))
 
         #: pod FEASIBILITY-CLASS key: (fit class, taint class, the pod's
-        #: host-written mask row) — pods of one template share it, so the
-        #: per-pod O(N) nonzero/normalize work below runs once per class.
+        #: host filter-row ids + exception column) — pods of one template
+        #: share it, so the per-pod O(N) nonzero/normalize work below runs
+        #: once per class. This is the SAME key the device-plane class
+        #: build uses (plus score parts there).
         feas_memo: dict[tuple, np.ndarray] = {}
         norm_memo: dict[tuple, tuple] = {}
 
         _pck_memo: dict[int, tuple] = {}
 
         def pod_class_key(i: int) -> tuple:
-            # Memoized: both the score-normalization memos and the
-            # shortlist class build key on it, and the mask-row tobytes
-            # is ~N bytes per call on mask-modified chunks.
             got = _pck_memo.get(i)
             if got is None:
-                mrow = static_mask[i, : ct.n_real].tobytes() \
-                    if static_mask is not None else None
                 got = _pck_memo[i] = (
-                    batch.req_class[i], batch.untol_class[i], mrow)
+                    batch.req_class[i], batch.untol_class[i],
+                    tuple(pod_rows.get(i, ())), pod_pin.get(i, -1),
+                    i in infeasible)
             return got
 
         def feasible_idx(i: int) -> np.ndarray:
@@ -1741,6 +1861,9 @@ class TPUBackend:
             pk = pod_class_key(i)
             got = feas_memo.get(pk)
             if got is not None:
+                return got
+            if i in infeasible:
+                got = feas_memo[pk] = np.zeros((0,), dtype=np.intp)
                 return got
             if fit_np is None:
                 uq = np.stack(batch.req_rows)  # (n_classes, R)
@@ -1758,8 +1881,16 @@ class TPUBackend:
                          ct.taint_filter_mat.shape[0]), dtype=np.bool_)
             feas = fit_np[batch.req_class[i], : ct.n_real] \
                 & taint_np[batch.untol_class[i], : ct.n_real]
-            if static_mask is not None:
-                feas = feas & static_mask[i, : ct.n_real]
+            rows_i = pod_rows.get(i)
+            if rows_i:
+                feas = feas.copy()
+                for cid in rows_i:
+                    feas &= row_store[cid]
+            pin = pod_pin.get(i)
+            if pin is not None:
+                keep = bool(feas[pin])
+                feas = np.zeros_like(feas)
+                feas[pin] = keep
             got = feas_memo[pk] = np.nonzero(feas)[0]
             return got
 
@@ -1789,7 +1920,8 @@ class TPUBackend:
                         st_nrt = self._nrt_state(plugin, snapshot, ct)
                         srow = self._nrt_score_row(st_nrt, pi, nrt_memo, i)
                         if srow.any():
-                            _get_scores()[i, : ct.n_real] += w * srow
+                            add_score_row(
+                                i, (w * srow).astype(np.float32))
                         continue
                     if name == "PodTopologySpread":
                         # Tensorized raw counts + vectorized NormalizeScore
@@ -1873,110 +2005,224 @@ class TPUBackend:
                 state = dyn_states.get(i) or CycleState()
                 plugin.normalize_scores(state, pi, raw)
                 if raw:
-                    hs = _get_scores()
+                    srow = np.zeros((ct.n_real,), dtype=np.float32)
                     for nname, s in raw.items():
-                        hs[i, ct.name_to_idx[nname]] += w * s
+                        srow[ct.name_to_idx[nname]] += w * s
+                    add_score_row(i, srow)
 
-        # Flush of the memoized normalized score rows. Preferred form:
-        # the ROW-DICTIONARY wire — distinct (combination of) rows +
-        # per-pod index, gathered on device — which never materializes
-        # the (P,N) plane at all. Falls back to one vectorized scatter
-        # per signature into the dense plane when other plugins already
-        # dirtied it or the chunk has too many distinct rows.
-        score_rows_np = score_idx_np = None
-        live = [e for e in norm_memo.values()
-                if e[1] is not None and e[2]]
-        if live:
-            pod_groups: dict[int, list[int]] = {}
-            for g, (feas, wnorm, idxs) in enumerate(live):
-                for i in idxs:
-                    pod_groups.setdefault(i, []).append(g)
-            combos: dict[tuple, list[int]] = {}
-            for i, gs in pod_groups.items():
-                combos.setdefault(tuple(gs), []).append(i)
-            if host_scores is None and len(combos) <= SCORE_ROWS_PAD - 1:
-                dense = []
-                for feas, wnorm, idxs in live:
-                    r = np.zeros((N,), dtype=np.float32)
-                    r[feas] = wnorm
-                    dense.append(r)
-                score_rows_np = np.zeros(
-                    (SCORE_ROWS_PAD, N), dtype=np.float32)
-                score_idx_np = np.zeros((P,), dtype=np.int32)
-                for k, (gs, idxs) in enumerate(combos.items(), start=1):
-                    for g in gs:
-                        score_rows_np[k] += dense[g]
-                    score_idx_np[np.asarray(idxs, dtype=np.intp)] = k
+        # Flush of the memoized normalized score rows: each group's
+        # sparse (feas, wnorm) pair densifies ONCE into an interned part
+        # row shared by every member pod — the r7 row-dictionary wire
+        # generalized; the class build below folds parts into (C,N)
+        # class score rows, so no per-pod plane exists for ANY number of
+        # distinct rows.
+        for feas, wnorm, idxs in norm_memo.values():
+            if wnorm is None or not idxs:
+                continue
+            srow = np.zeros((ct.n_real,), dtype=np.float32)
+            srow[feas] = wnorm
+            sid = _intern_score(srow)
+            for i in idxs:
+                pod_parts.setdefault(i, []).append(sid)
+
+        # ---- class-dictionary plane build (the native device format) --
+        # Pods dedupe into equivalence classes keyed by (request row,
+        # toleration row, filter-row ids, ordered score-part ids) —
+        # exception pins deliberately EXCLUDED, they ride the sparse
+        # exc vector so a pinned pod shares its template's class. Class
+        # 0 is reserved EMPTY (padding pods, unknown resources,
+        # conflicting pins). Overflowing the cap — or the
+        # KTPU_CLASS_PLANES=0 kill switch (cap 0) — falls back to
+        # per-pod planes (C == P, identity index): structurally the
+        # pre-class dense format, bit-identical assignments.
+        cap = ctx.class_pad
+        mask_dirty = bool(pod_rows or pod_pin or infeasible)
+        scores_dirty = bool(pod_parts)
+        dirty = mask_dirty or scores_dirty
+        R = len(ct.resources)
+        tf = batch.untol_filter.shape[1]
+        tp = batch.untol_prefer.shape[1]
+        class_reps: list[int] | None = None
+        class_parts: list[tuple] = []
+        cls_np = exc_np = None
+        if cap:
+            cls_map: dict[tuple, int] = {}
+            class_reps = []
+            cls_np = np.zeros((P,), dtype=np.int32)
+            exc_np = np.full((P,), -1, dtype=np.int32)
+            for i in range(batch.p_real):
+                if i in infeasible:
+                    continue                                   # class 0
+                pin = pod_pin.get(i)
+                # A pinned pod's argmax ranges over AT MOST one column,
+                # so its score row cannot change its assignment — drop
+                # its parts from the key (and the plane) rather than
+                # let per-pin normalization split every pinned pod into
+                # its own class. The class score row sums the KEY's
+                # parts (class_parts), never the rep's, so a pinned rep
+                # can't smuggle its dropped parts into a shared class.
+                eff_parts = () if pin is not None \
+                    else tuple(pod_parts.get(i, ()))
+                ckey = (batch.req_class[i], batch.untol_class[i],
+                        tuple(pod_rows.get(i, ())), eff_parts)
+                c = cls_map.get(ckey)
+                if c is None:
+                    if len(class_reps) >= cap:
+                        class_reps = None
+                        break
+                    c = cls_map[ckey] = len(class_reps) + 1
+                    class_reps.append(i)
+                    class_parts.append(eff_parts)
+                cls_np[i] = c
+                if pin is not None:
+                    exc_np[i] = pin
+
+        plane_bytes = 0
+        if class_reps is not None:
+            crows = _class_rows_bucket(len(class_reps))
+            n_cls = len(class_reps)
+            pack_np = np.zeros((crows, 2 * R + tf + tp), dtype=np.int32)
+            if n_cls:
+                ridx = np.asarray(class_reps, dtype=np.intp)
+                pack_np[1: n_cls + 1] = np.concatenate(
+                    [batch.req_q[ridx], batch.req_nz_q[ridx],
+                     batch.untol_filter[ridx].astype(np.int32),
+                     batch.untol_prefer[ridx].astype(np.int32)], axis=1)
+            # Mask and score planes are cached INDEPENDENTLY (the r6
+            # packed-wire discipline): a chunk with only score rows
+            # keeps its clean cached mask and uploads scores alone, and
+            # vice versa. Cache keys carry a format tag — the class and
+            # per-pod keys are both 4-int tuples otherwise and could
+            # collide at toy node pads.
+            if mask_dirty:
+                mask_np = np.zeros((crows, N), dtype=np.bool_)
+                rowset_memo: dict[tuple, np.ndarray] = {}
+                for c, rep in enumerate(class_reps, start=1):
+                    rs = tuple(pod_rows.get(rep, ()))
+                    row = rowset_memo.get(rs)
+                    if row is None:
+                        row = np.ones((ct.n_real,), dtype=np.bool_)
+                        for cid in rs:
+                            row = row & row_store[cid]
+                        rowset_memo[rs] = row
+                    mask_np[c, : ct.n_real] = row
+                packed = np.packbits(mask_np, axis=1)
+                dev_mask = self._put(packed, "pn")
+                plane_bytes += packed.nbytes
             else:
-                for feas, wnorm, idxs in live:
-                    _get_scores()[np.ix_(
-                        np.asarray(idxs, dtype=np.intp), feas)] += wnorm
+                # Clean mask: all-true for every real class — depends
+                # only on (plane rows, class count, node count), so one
+                # cached upload serves every such chunk of the shape.
+                mkey = ("cls", crows, n_cls, N, ct.n_real)
+                dev_mask = self._dev_base_mask.get(mkey)
+                if dev_mask is None:
+                    mask_np = np.zeros((crows, N), dtype=np.bool_)
+                    mask_np[1: n_cls + 1, : ct.n_real] = True
+                    packed = np.packbits(mask_np, axis=1)
+                    dev_mask = self._dev_base_mask[mkey] = \
+                        self._put(packed, "pn")
+                    plane_bytes += packed.nbytes
+            if scores_dirty:
+                scores_np = np.zeros((crows, N), dtype=np.float32)
+                for c, parts in enumerate(class_parts, start=1):
+                    for sid in parts:
+                        scores_np[c, : ct.n_real] += score_store[sid]
+                wire_scores = compress_score_wire(scores_np)
+                dev_scores = self._put(wire_scores, "pn")
+                plane_bytes += wire_scores.nbytes
+            else:
+                dev_scores = self._dev_zero_scores.get((crows, N))
+                if dev_scores is None:
+                    dev_scores = self._dev_zero_scores[(crows, N)] = \
+                        self._put(np.zeros((crows, N), dtype=np.float16),
+                                  "pn")
+                    plane_bytes += crows * N * 2
+        else:
+            # Per-pod fallback (kill switch / class overflow): C == P,
+            # identity index — the planes the pre-class format shipped.
+            crows = P
+            cls_np = None  # identity: served from the _dev_arange cache
+            exc_np = np.full((P,), -1, dtype=np.int32)
+            pack_np = np.concatenate(
+                [batch.req_q, batch.req_nz_q,
+                 batch.untol_filter.astype(np.int32),
+                 batch.untol_prefer.astype(np.int32)], axis=1)
+            if cap and self.metrics is not None:
+                # Genuine class overflow (not the kill switch): counted
+                # per pod, like the other degradation kinds.
+                self.metrics.class_split_fallbacks.inc(batch.p_real)
+            if mask_dirty:
+                mask_np = np.zeros((P, N), dtype=np.bool_)
+                mask_np[: batch.p_real, : ct.n_real] = True
+                for i, lst in pod_rows.items():
+                    for cid in lst:
+                        mask_np[i, : ct.n_real] &= row_store[cid]
+                for i, pin in pod_pin.items():
+                    keep = mask_np[i, pin]
+                    mask_np[i, :] = False
+                    mask_np[i, pin] = keep
+                for i in infeasible:
+                    mask_np[i, :] = False
+                packed = np.packbits(mask_np, axis=1)
+                dev_mask = self._put(packed, "pn")
+                plane_bytes += packed.nbytes
+            else:
+                mkey = ("pod", P, N, batch.p_real, ct.n_real)
+                dev_mask = self._dev_base_mask.get(mkey)
+                if dev_mask is None:
+                    mask_np = np.zeros((P, N), dtype=np.bool_)
+                    mask_np[: batch.p_real, : ct.n_real] = True
+                    packed = np.packbits(mask_np, axis=1)
+                    dev_mask = self._dev_base_mask[mkey] = \
+                        self._put(packed, "pn")
+                    plane_bytes += packed.nbytes
+            if scores_dirty:
+                scores_np = np.zeros((P, N), dtype=np.float32)
+                for i, parts in pod_parts.items():
+                    for sid in parts:
+                        scores_np[i, : ct.n_real] += score_store[sid]
+                wire_scores = compress_score_wire(scores_np)
+                dev_scores = self._put(wire_scores, "pn")
+                plane_bytes += wire_scores.nbytes
+            else:
+                dev_scores = self._dev_zero_scores.get((P, N))
+                if dev_scores is None:
+                    dev_scores = self._dev_zero_scores[(P, N)] = \
+                        self._put(np.zeros((P, N), dtype=np.float16), "pn")
+                    plane_bytes += P * N * 2
 
-        # Reuse device-resident constants when untouched (remote-TPU upload
-        # bandwidth is the bottleneck at 5k nodes). Dirty uploads are
-        # compressed for the relay: masks bit-packed (8×: a (2048×5120)
-        # bool mask is 10.5 MB raw, 1.3 MB packed — at ~12 MB/s the raw
-        # form alone throttled the affinity/spread families), scores sent
-        # float16 (2×; unpacked/cast on device in the fused program).
-        if mask_modified:
-            dev_mask = self._put(np.packbits(static_mask, axis=1), "pn")
+        # The (P,) class index + exception vector + (C, ·) rep-row pack
+        # ride every chunk (tiny); the identity index (per-pod fallback)
+        # and the no-exception vector reuse one cached upload per width.
+        if cls_np is None:
+            cls_np = np.arange(P, dtype=np.int32)
+            dev_cls = self._dev_arange.get(P)
+            if dev_cls is None:
+                dev_cls = self._dev_arange[P] = self._put(cls_np)
+                plane_bytes += cls_np.nbytes
         else:
-            dev_mask = self._dev_base_mask.get(base_key)
-            if dev_mask is None:
-                dev_mask = self._dev_base_mask[base_key] = \
-                    self._put(np.packbits(_get_mask(), axis=1), "pn")
-        if scores_modified:
-            dev_scores = self._put(compress_score_wire(host_scores), "pn")
+            dev_cls = self._put(cls_np)
+            plane_bytes += cls_np.nbytes
+        dev_pack = self._put(pack_np)
+        plane_bytes += pack_np.nbytes
+        if pod_pin and class_reps is not None:
+            dev_exc = self._put(exc_np)
+            plane_bytes += exc_np.nbytes
         else:
-            dev_scores = self._dev_zero_scores.get((P, N))
-            if dev_scores is None:
-                dev_scores = self._dev_zero_scores[(P, N)] = \
-                    self._put(np.zeros((P, N), dtype=np.float16), "pn")
-        if score_rows_np is not None:
-            dev_srows = self._put(compress_score_wire(score_rows_np), "pn")
-            dev_sidx = self._put(score_idx_np)
-        else:
-            z = self._dev_zero_srows.get((P, N))
-            if z is None:
-                z = self._dev_zero_srows[(P, N)] = (
-                    self._put(np.zeros((SCORE_ROWS_PAD, N),
-                                       dtype=np.float16), "pn"),
-                    self._put(np.zeros((P,), dtype=np.int32)))
-            dev_srows, dev_sidx = z
+            dev_exc = self._dev_no_exc.get(P)
+            if dev_exc is None:
+                dev_exc = self._dev_no_exc[P] = self._put(
+                    np.full((P,), -1, dtype=np.int32))
 
-        # Shortlist classes: pods sharing (request row, toleration row,
-        # mask row, score-dictionary row) have bit-identical chunk-start
-        # score rows, so the device prefilter computes one row per CLASS
-        # (template batches: a handful) instead of per pod. A dense host
-        # score plane defeats row sharing (per-pod float rows — hashing
-        # them would cost more than the pruning saves), and more classes
-        # than the pad means a genuinely heterogeneous chunk: both keep
-        # the full N-wide scan for this chunk.
+        # Shortlist activation: the chunk-start prefilter reads the
+        # class planes directly (O(C·N)), so the pruned solve runs for
+        # EVERY class-mode chunk the tuner's width policy accepts —
+        # heterogeneous score rows no longer defeat it (they are class
+        # rows now). The per-pod fallback keeps the full N-wide scan: a
+        # (P,N) prefilter would cost more than the pruning saves.
         shortlist_k = 0
-        sl_reps_np = sl_class_np = None
-        if not scores_modified:
-            k = self._tuner.shortlist_k(P, ct.n_real)
-            if k:
-                sl_class_np = np.zeros((P,), dtype=np.int32)
-                reps: list[int] | None = []
-                cls_map: dict[tuple, int] = {}
-                for i in range(batch.p_real):
-                    ckey = (pod_class_key(i),
-                            int(score_idx_np[i])
-                            if score_idx_np is not None else 0)
-                    c = cls_map.get(ckey)
-                    if c is None:
-                        if len(reps) >= SHORTLIST_CLASS_PAD:
-                            reps = None
-                            break
-                        c = cls_map[ckey] = len(reps)
-                        reps.append(i)
-                    sl_class_np[i] = c
-                if reps is not None:
-                    shortlist_k = k
-                    sl_reps_np = np.zeros(
-                        (SHORTLIST_CLASS_PAD,), dtype=np.int32)
-                    sl_reps_np[: len(reps)] = reps
+        if class_reps is not None:
+            shortlist_k = self._tuner.shortlist_k(P, ct.n_real)
 
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are
@@ -2065,11 +2311,19 @@ class TPUBackend:
                         gang_onehot[i, g] = 1.0
                     gang_required[g] = min(max(mm - assembled, 0), len(idxs))
 
-        self._tuner.observe_chunk(mask_modified or scores_modified)
+        self._tuner.observe_chunk(dirty)
+        if self.metrics is not None:
+            self.metrics.plane_classes.set(
+                len(class_reps) if class_reps is not None else batch.p_real)
+            if plane_bytes:
+                self.metrics.plane_bytes.inc(plane_bytes)
+            self.metrics.prep_duration.observe(
+                time.perf_counter() - prep_t0)
         return {
             "pods": pods, "batch": batch,
             "dev_mask": dev_mask, "dev_scores": dev_scores,
-            "dev_srows": dev_srows, "dev_sidx": dev_sidx,
+            "dev_cls": dev_cls, "dev_exc": dev_exc, "dev_pack": dev_pack,
+            "cls_np": cls_np,
             "host_filter_fail": host_filter_fail,
             "unknown_res": unknown_res, "stateful_pods": stateful_pods,
             "spread_active_idx": spread_active_idx,
@@ -2077,8 +2331,7 @@ class TPUBackend:
             "chunk_idx": chunk_idx,
             "dev_perms": dev_perms, "gang_onehot": gang_onehot,
             "gang_required": gang_required,
-            "shortlist_k": shortlist_k, "sl_reps": sl_reps_np,
-            "sl_class": sl_class_np,
+            "shortlist_k": shortlist_k,
             "scan_width": (shortlist_k + P) if shortlist_k else ct.n_real,
         }
 
@@ -2115,10 +2368,6 @@ class TPUBackend:
             }
             self._dev_static_fp = ct._static_fp
 
-        pod_pack = np.concatenate(
-            [batch.req_q, batch.req_nz_q,
-             batch.untol_filter.astype(np.int32),
-             batch.untol_prefer.astype(np.int32)], axis=1)
         sp = ctx.spread
         # The spread scan must run for any chunk whose pods contribute to
         # the table's counts (a non-spread pod matching a template's
@@ -2139,28 +2388,17 @@ class TPUBackend:
                        self._put(prep["sp_contrib"]))
         else:
             sp_args = self._spread_dummies(ct.n_pad, batch.req_q.shape[0])
-        if prep["shortlist_k"]:
-            sl_args = (self._put(prep["sl_reps"]),
-                       self._put(prep["sl_class"]))
-        else:
-            P = batch.req_q.shape[0]
-            sl_args = self._dev_zero_sl.get(P)
-            if sl_args is None:
-                sl_args = self._dev_zero_sl[P] = (
-                    self._put(np.zeros((SHORTLIST_CLASS_PAD,), np.int32)),
-                    self._put(np.zeros((P,), np.int32)))
         assign_d, used_pack2, fit0_d, taint_ok_d, dom_counts2 = \
             _mask_solve_update(
                 self._dev_static["alloc_q"], self._dev_used,
-                self._dev_static["alloc_pods"], self._put(pod_pack),
+                self._dev_static["alloc_pods"], prep["dev_pack"],
+                prep["dev_cls"], prep["dev_exc"],
                 self._dev_static["taint_f"], self._dev_static["taint_p"],
                 prep["dev_mask"], prep["dev_scores"],
-                prep["dev_srows"], prep["dev_sidx"],
                 p["fit_col_w"], p["bal_col_mask"], p["shape_u"], p["shape_s"],
                 p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
                 *sp_args,
                 prep["dev_perms"], *self._gang_args(prep, batch),
-                *sl_args,
                 p["strategy"], use_spread, prep["shortlist_k"],
             )
         self._dev_used = used_pack2
@@ -2265,6 +2503,7 @@ class TPUBackend:
                 taint_ok = np.asarray(run["taint_ok_d"])
             self._build_diagnostics(
                 need_diag, pods, ctx.ct, batch, fit0, taint_ok,
+                run["cls_np"],
                 run["host_filter_fail"], ctx.params["filter_names"],
                 ctx.diagnostics, run["unknown_res"])
 
@@ -2416,10 +2655,16 @@ class TPUBackend:
     # -- explainability ------------------------------------------------------
 
     def _build_diagnostics(self, idxs, pods, ct, batch, fit0, taint_ok,
-                           host_filter_fail, filter_names, diagnostics,
-                           unknown_res):
+                           cls_np, host_filter_fail, filter_names,
+                           diagnostics, unknown_res):
         """Per-node, per-plugin failure reasons from the preserved unsat
-        masks — feeds FitError's "0/N nodes are available: ..." summary."""
+        masks — feeds FitError's "0/N nodes are available: ..." summary.
+
+        fit0/taint_ok are CLASS-level (C, N) planes; each pod reads its
+        class row through cls_np (exact — the class shares the pod's
+        request/toleration rows by construction). Host plugin failures
+        come from the per-pod ok-row dicts the prep recorded (shared row
+        objects, no plane)."""
         taint_st = Status.unschedulable(
             "node(s) had untolerated taint", resolvable=False
         ).with_plugin("TaintToleration")
@@ -2466,16 +2711,20 @@ class TPUBackend:
             assigned = np.zeros((n_real,), dtype=bool)
             banned = np.zeros((n_real,), dtype=bool)
             agg: list[tuple[Status, int]] = []
+            ci = int(cls_np[i])
             if taint_on:
-                m = ~taint_ok[i, :n_real]
+                m = ~taint_ok[ci, :n_real]
                 statuses[m] = taint_st
                 assigned |= m
                 banned |= m
                 c = int(m.sum())
                 if c:
                     agg.append((taint_st, c))
-            for pname, ok in host_filter_fail.items():
-                m = ~ok[i, :n_real] & ~assigned
+            for pname, okmap in host_filter_fail.items():
+                ok_row = okmap.get(i)
+                if ok_row is None:
+                    continue
+                m = ~ok_row[:n_real] & ~assigned
                 statuses[m] = host_statuses[pname]
                 assigned |= m
                 if host_statuses[pname].code == \
@@ -2555,7 +2804,7 @@ class _AssignCtx:
                  "assignments", "diagnostics",
                  "working", "delta", "delta_has_terms", "sel_cache",
                  "delta_idx", "wsnap", "spread", "spread_poisoned",
-                 "spread_last_gated", "chunk_seq")
+                 "spread_last_gated", "chunk_seq", "class_pad")
 
 
 def _cached_matcher(term: dict, owner_ns: str, sel_cache: dict,

@@ -23,6 +23,19 @@ Two solvers:
 
 Both are shape-static, jit-compiled once per (P, N, R) signature, and emit
 `(P,) int32` node indices with -1 = unschedulable-this-cycle.
+
+Class-dictionary planes: every scan reads `mask`/`static_scores` as
+CLOSED-OVER planes addressed per step through `rows` — a (P,) row index
+mapping each pod to its plane row. The backend ships (C, N) planes over
+pod EQUIVALENCE CLASSES (pods sharing request/toleration/host-row/score
+signatures — template batches have a handful) with `rows = class index
+per pod`, so no (P, N) plane exists on host or device; the legacy
+per-pod form is the degenerate `rows = arange(P)` (C == P), which is
+also the KTPU_CLASS_PLANES=0 kill-switch shape. Per-pod residuals that
+would otherwise split a class — single-allowed-column host rows
+(NodeName, DRA allocated-claim pinning) — ride the sparse exception
+vector `exc`: (P,) int32, -1 = none, else the ONE global column the pod
+is additionally restricted to (intersected with its class row).
 """
 
 from __future__ import annotations
@@ -71,7 +84,7 @@ def greedy_assign(req_q, free_q, free_pods, mask, scores):
 def greedy_assign_rescoring(req_q, req_nz_q, free_q, free_pods, used_nz_q,
                             alloc_q, mask, static_scores, fit_col_w,
                             bal_col_mask, shape_u, shape_s, w_fit, w_bal,
-                            strategy: str):
+                            strategy: str, rows=None, exc=None):
     """Sequential-equivalent greedy with **live re-scoring**.
 
     The capacity-dependent score plugins (NodeResourcesFit strategies,
@@ -82,19 +95,31 @@ def greedy_assign_rescoring(req_q, req_nz_q, free_q, free_pods, used_nz_q,
     one node, wrecking the balance/fragmentation the scorers exist for.
 
     Capacity-independent score components (taints, host rows, weights
-    already applied) arrive pre-summed in `static_scores` (P,N).
+    already applied) arrive pre-summed in `static_scores` — (C, N) class
+    planes addressed through `rows` (see module docstring); with
+    rows=None the planes are per-pod (C == P, row = pod). `exc` is the
+    optional (P,) single-allowed-column restriction (-1 = none).
     """
     from kubernetes_tpu.ops import kernels  # local to avoid import cycle
 
     n = free_q.shape[0]
+    p = req_q.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
+    if rows is None:
+        rows = jnp.arange(p, dtype=jnp.int32)
 
     def step(carry, inp):
         free_q, free_pods, used_nz = carry
-        req, req_nz, m, sc_static = inp
+        if exc is None:
+            req, req_nz, row = inp
+        else:
+            req, req_nz, row, e = inp
+        m = mask[row]
+        if exc is not None:
+            m = m & ((e < 0) | (iota == e))
         fits = m & jnp.all(req[None, :] <= free_q, axis=1) & (free_pods >= 1)
         any_fit = jnp.any(fits)
-        sc = sc_static
+        sc = static_scores[row]
         sc = sc + w_fit * kernels.fit_score(
             alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
             shape_u, shape_s)[0]
@@ -109,9 +134,10 @@ def greedy_assign_rescoring(req_q, req_nz_q, free_q, free_pods, used_nz_q,
         used_nz = used_nz + jnp.where(hit[:, None], req_nz[None, :], 0)
         return (free_q, free_pods, used_nz), idx
 
+    xs = (req_q, req_nz_q, rows) if exc is None \
+        else (req_q, req_nz_q, rows, exc)
     (_, _, _), assign = lax.scan(
-        step, (free_q, free_pods, used_nz_q),
-        (req_q, req_nz_q, mask, static_scores))
+        step, (free_q, free_pods, used_nz_q), xs)
     return assign
 
 
@@ -122,7 +148,8 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
                                    w_fit, w_bal, strategy: str,
                                    dom_onehot, cid_onehot, dom_counts,
                                    max_skew, min_ok, has_key_nc,
-                                   applies, contributes):
+                                   applies, contributes, rows=None,
+                                   exc=None):
     """greedy_assign_rescoring + PodTopologySpread hard constraints INSIDE
     the scan (sequential-equivalent, like capacity).
 
@@ -162,14 +189,20 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
         the chunk, spread-constrained or not. Doubles as the per-pod
         selfMatch term of the skew check (filtering.go selfMatchNum).
 
+    `rows`/`exc` are the class-plane indirection of the module docstring
+    (rows=None ⇒ per-pod planes). applies/contributes stay per-pod.
+
     Returns (assign, dom_counts') so the caller can chain counts across
     chunks on device, exactly like the packed used-state.
     """
     from kubernetes_tpu.ops import kernels  # local to avoid import cycle
 
     n = free_q.shape[0]
+    p = req_q.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
     big = jnp.float32(1e30)
+    if rows is None:
+        rows = jnp.arange(p, dtype=jnp.int32)
     # Static per-constraint node→eligible-domain membership: nodes outside
     # it (but keyed) take the fresh-domain pass.
     in_dom_nc = (dom_onehot @ cid_onehot) > 0                          # (N,C)
@@ -177,7 +210,14 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
 
     def step(carry, inp):
         free_q, free_pods, used_nz, dcounts = carry
-        req, req_nz, m, sc_static, app, contrib = inp
+        if exc is None:
+            req, req_nz, row, app, contrib = inp
+        else:
+            req, req_nz, row, app, contrib, e = inp
+        m = mask[row]
+        if exc is not None:
+            m = m & ((e < 0) | (iota == e))
+        sc_static = static_scores[row]
         # min count over each constraint's domains (empty domains included),
         # floored to 0 under a minDomains deficit.
         min_c = jnp.min(
@@ -219,9 +259,10 @@ def greedy_assign_rescoring_spread(req_q, req_nz_q, free_q, free_pods,
             0.0)
         return (free_q, free_pods, used_nz, dcounts), idx
 
+    xs = (req_q, req_nz_q, rows, applies, contributes) if exc is None \
+        else (req_q, req_nz_q, rows, applies, contributes, exc)
     (_, _, _, dom_counts2), assign = lax.scan(
-        step, (free_q, free_pods, used_nz_q, dom_counts),
-        (req_q, req_nz_q, mask, static_scores, applies, contributes))
+        step, (free_q, free_pods, used_nz_q, dom_counts), xs)
     return assign, dom_counts2
 
 
@@ -230,7 +271,7 @@ def multistart_greedy_assign(req_q, req_nz_q, free_q, free_pods, used_nz_q,
                              alloc_q, mask, static_scores, fit_col_w,
                              bal_col_mask, shape_u, shape_s, w_fit, w_bal,
                              strategy: str, perms, gang_onehot,
-                             gang_required):
+                             gang_required, rows=None, exc=None):
     """K permuted greedy scans in parallel + gang all-or-nothing.
 
     Sequential greedy in queue order is the oracle, but it strands capacity
@@ -254,23 +295,31 @@ def multistart_greedy_assign(req_q, req_nz_q, free_q, free_pods, used_nz_q,
     return _multistart_body(
         req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
         static_scores, fit_col_w, bal_col_mask, shape_u, shape_s, w_fit,
-        w_bal, strategy, perms, gang_onehot, gang_required)
+        w_bal, strategy, perms, gang_onehot, gang_required, rows, exc)
 
 
 def _multistart_body(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
                      mask, static_scores, fit_col_w, bal_col_mask, shape_u,
                      shape_s, w_fit, w_bal, strategy, perms, gang_onehot,
-                     gang_required):
+                     gang_required, rows=None, exc=None):
     """Traceable multistart core — also the shortlist path's whole-chunk
-    fallback branch (see multistart_greedy_assign_shortlist)."""
+    fallback branch (see multistart_greedy_assign_shortlist).
+
+    Only the small per-pod vectors permute; the (C, N) planes stay
+    closed-over and each order addresses them through `rows[perm]` —
+    permuting the planes themselves would materialize one (P, N) copy
+    per order, exactly what the class-dictionary format removes."""
     P = req_q.shape[0]
     arange_p = jnp.arange(P, dtype=jnp.int32)
+    if rows is None:
+        rows = arange_p
 
     def one(perm):
         a = greedy_assign_rescoring(
             req_q[perm], req_nz_q[perm], free_q, free_pods, used_nz_q,
-            alloc_q, mask[perm], static_scores[perm], fit_col_w,
-            bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy)
+            alloc_q, mask, static_scores, fit_col_w,
+            bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy,
+            rows=rows[perm], exc=None if exc is None else exc[perm])
         inv = jnp.zeros_like(perm).at[perm].set(arange_p)
         return a[inv]
 
@@ -341,7 +390,7 @@ def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
                     alloc_q, mask, static_scores, fit_col_w, bal_col_mask,
                     shape_u, shape_s, w_fit, w_bal, strategy: str,
                     sc0, sl_class, sl_cand, sl_thresh, has_node,
-                    inline_fallback: bool):
+                    inline_fallback: bool, exc=None):
     """The narrow sequential-equivalent scan: per pod, re-score only the
     pod's K shortlist columns plus every node already debited this chunk,
     and prove the winner exact against the prefilter threshold.
@@ -370,12 +419,18 @@ def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
     to -1 with no fallback.
 
     `rows` (P,) maps each step to its pod's row in the UNPERMUTED
-    (P,N) mask/static_scores planes, which stay closed-over: the trusted
-    path reads them through (row, ci) element gathers, never a row slice
+    mask/static_scores planes (class planes — (C, N); C == P in the
+    per-pod degenerate form), which stay closed-over: the trusted path
+    reads them through (row, ci) element gathers, never a row slice
     — an (N,)-wide xs row per step would put O(N) memory traffic back
     into the scan (and a permuted multistart copy would materialize the
     planes once per order). Only the fallback branch slices a full row,
-    and only when taken.
+    and only when taken. `exc` (optional (P,)) is the per-pod
+    single-allowed-column exception: candidates outside it are
+    infeasible for the pod, so a pinned pod whose column misses the
+    class shortlist resolves through the bound-check fallback (all its
+    candidates mask out → not trusted unless the shortlist already held
+    every feasible class column).
 
     Returns (assign (P,), fallbacks int32, poisoned bool). With
     inline_fallback the assignment is exact and poisoned is always False;
@@ -385,10 +440,14 @@ def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
 
     n = free_q.shape[0]
     p = req_q.shape[0]
+    iota_n = jnp.arange(n, dtype=jnp.int32)
 
     def step(carry, inp):
         free_q, free_pods, used_nz, touched, tidx, kstep, nfall, pois = carry
-        req, req_nz, row, cand, t, cls, hn = inp
+        if exc is None:
+            req, req_nz, row, cand, t, cls, hn = inp
+        else:
+            req, req_nz, row, cand, t, cls, hn, e = inp
         cset = jnp.concatenate([cand, tidx])               # (K+P,)
         valid = cset < n
         ci = jnp.where(valid, cset, 0)
@@ -402,6 +461,8 @@ def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
         fits = mask[row, ci] & valid \
             & jnp.all(req[None, :] <= free_q[ci], axis=1) \
             & (free_pods[ci] >= 1)
+        if exc is not None:
+            fits = fits & ((e < 0) | (ci == e))
         masked = jnp.where(fits, live, NEG_INF)
         best = jnp.max(masked)
         any_fit = best > NEG_INF
@@ -416,6 +477,8 @@ def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
             def full_row(_):
                 fits_n = mask[row] & jnp.all(req[None, :] <= free_q, axis=1) \
                     & (free_pods >= 1)
+                if exc is not None:
+                    fits_n = fits_n & ((e < 0) | (iota_n == e))
                 sc = static_scores[row]
                 sc = sc + w_fit * kernels.fit_score(
                     alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
@@ -450,9 +513,10 @@ def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
               jnp.zeros((n,), jnp.bool_),
               jnp.full((p,), n, jnp.int32),
               jnp.int32(0), jnp.int32(0), jnp.bool_(False))
-    (_, _, _, _, _, _, nfall, pois), assign = lax.scan(
-        step, carry0,
-        (req_q, req_nz_q, rows, sl_cand, sl_thresh, sl_class, has_node))
+    xs = (req_q, req_nz_q, rows, sl_cand, sl_thresh, sl_class, has_node)
+    if exc is not None:
+        xs = xs + (exc,)
+    (_, _, _, _, _, _, nfall, pois), assign = lax.scan(step, carry0, xs)
     return assign, nfall, pois
 
 
@@ -463,17 +527,18 @@ def greedy_assign_rescoring_shortlist(req_q, req_nz_q, free_q, free_pods,
                                       shape_u, shape_s, w_fit, w_bal,
                                       strategy: str,
                                       sc0, sl_class, sl_cand, sl_thresh,
-                                      has_node):
+                                      has_node, rows=None, exc=None):
     """greedy_assign_rescoring, shortlist-pruned: bit-identical assignments
     at O(P·(K+P)) with per-step inline fallback to the full N-wide row
     (the lax.cond executes one branch — fallbacks cost O(N) only when
     taken). Returns (assign (P,), fallbacks int32)."""
-    rows = jnp.arange(req_q.shape[0], dtype=jnp.int32)
+    if rows is None:
+        rows = jnp.arange(req_q.shape[0], dtype=jnp.int32)
     assign, nfall, _ = _shortlist_scan(
         req_q, req_nz_q, rows, free_q, free_pods, used_nz_q, alloc_q, mask,
         static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
         w_fit, w_bal, strategy, sc0, sl_class, sl_cand, sl_thresh,
-        has_node, inline_fallback=True)
+        has_node, inline_fallback=True, exc=exc)
     return assign, nfall
 
 
@@ -485,7 +550,7 @@ def multistart_greedy_assign_shortlist(req_q, req_nz_q, free_q, free_pods,
                                        w_fit, w_bal, strategy: str, perms,
                                        gang_onehot, gang_required,
                                        sc0, sl_class, sl_cand, sl_thresh,
-                                       has_node):
+                                       has_node, rows=None, exc=None):
     """multistart_greedy_assign, shortlist-pruned.
 
     The K permuted scans run vmapped, so a per-step lax.cond would lower
@@ -500,17 +565,20 @@ def multistart_greedy_assign_shortlist(req_q, req_nz_q, free_q, free_pods,
     whole-chunk here (P on a poisoned chunk, 0 otherwise)."""
     P = req_q.shape[0]
     arange_p = jnp.arange(P, dtype=jnp.int32)
+    if rows is None:
+        rows = arange_p
 
     def one(perm):
-        # Only the small per-pod vectors permute; the (P,N) planes stay
-        # unpermuted and the scan addresses them through `rows=perm` —
+        # Only the small per-pod vectors permute; the class planes stay
+        # unpermuted and the scan addresses them through `rows[perm]` —
         # permuting them here would materialize one copy per order.
         a, _, pois = _shortlist_scan(
-            req_q[perm], req_nz_q[perm], perm, free_q, free_pods,
+            req_q[perm], req_nz_q[perm], rows[perm], free_q, free_pods,
             used_nz_q, alloc_q, mask, static_scores, fit_col_w,
             bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy,
             sc0, sl_class[perm], sl_cand[perm], sl_thresh[perm],
-            has_node[perm], inline_fallback=False)
+            has_node[perm], inline_fallback=False,
+            exc=None if exc is None else exc[perm])
         inv = jnp.zeros_like(perm).at[perm].set(arange_p)
         return a[inv], pois
 
@@ -521,7 +589,8 @@ def multistart_greedy_assign_shortlist(req_q, req_nz_q, free_q, free_pods,
         return _multistart_body(
             req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
             static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
-            w_fit, w_bal, strategy, perms, gang_onehot, gang_required)
+            w_fit, w_bal, strategy, perms, gang_onehot, gang_required,
+            rows, exc)
 
     def take(_):
         return _select_best(assigns, req_q, gang_onehot, gang_required)
@@ -537,7 +606,7 @@ def greedy_assign_rescoring_spread_shortlist(
         w_fit, w_bal, strategy: str,
         dom_onehot, cid_onehot, dom_counts, max_skew, min_ok, has_key_nc,
         applies, contributes,
-        sc0, sl_class, sl_cand, sl_thresh, has_node):
+        sc0, sl_class, sl_cand, sl_thresh, has_node, rows=None, exc=None):
     """greedy_assign_rescoring_spread, shortlist-pruned (identity order,
     inline per-step fallback like the non-spread scan).
 
@@ -555,15 +624,19 @@ def greedy_assign_rescoring_spread_shortlist(
     n = free_q.shape[0]
     p = req_q.shape[0]
     big = jnp.float32(1e30)
+    iota_n = jnp.arange(n, dtype=jnp.int32)
     in_dom_nc = (dom_onehot @ cid_onehot) > 0                          # (N,C)
     gate_nc = has_key_nc > 0
 
-    rows_p = jnp.arange(p, dtype=jnp.int32)
+    rows_p = jnp.arange(p, dtype=jnp.int32) if rows is None else rows
 
     def step(carry, inp):
         (free_q, free_pods, used_nz, dcounts, touched, tidx, kstep,
          nfall) = carry
-        req, req_nz, row, app, contrib, cand, t, cls, hn = inp
+        if exc is None:
+            req, req_nz, row, app, contrib, cand, t, cls, hn = inp
+        else:
+            req, req_nz, row, app, contrib, cand, t, cls, hn, e = inp
         min_c = jnp.min(
             jnp.where(cid_onehot > 0, dcounts[:, None], big), axis=0)  # (C,)
         min_c = min_c * min_ok
@@ -589,6 +662,8 @@ def greedy_assign_rescoring_spread_shortlist(
         fits = mask[row, ci] & valid & spread_ok_c \
             & jnp.all(req[None, :] <= free_q[ci], axis=1) \
             & (free_pods[ci] >= 1)
+        if exc is not None:
+            fits = fits & ((e < 0) | (ci == e))
         masked = jnp.where(fits, live, NEG_INF)
         best = jnp.max(masked)
         any_fit = best > NEG_INF
@@ -606,6 +681,8 @@ def greedy_assign_rescoring_spread_shortlist(
             spread_ok = jnp.all(node_c_ok | (app[None, :] == 0), axis=1)
             fits_n = mask[row] & jnp.all(req[None, :] <= free_q, axis=1) \
                 & (free_pods >= 1) & spread_ok
+            if exc is not None:
+                fits_n = fits_n & ((e < 0) | (iota_n == e))
             sc = static_scores[row]
             sc = sc + w_fit * kernels.fit_score(
                 alloc_q, used_nz, req_nz[None, :], fit_col_w, strategy,
@@ -639,10 +716,12 @@ def greedy_assign_rescoring_spread_shortlist(
               jnp.zeros((n,), jnp.bool_),
               jnp.full((p,), n, jnp.int32),
               jnp.int32(0), jnp.int32(0))
+    xs = (req_q, req_nz_q, rows_p, applies, contributes,
+          sl_cand, sl_thresh, sl_class, has_node)
+    if exc is not None:
+        xs = xs + (exc,)
     (_, _, _, dom_counts2, _, _, _, nfall), assign = lax.scan(
-        step, carry0,
-        (req_q, req_nz_q, rows_p, applies, contributes,
-         sl_cand, sl_thresh, sl_class, has_node))
+        step, carry0, xs)
     return assign, dom_counts2, nfall
 
 

@@ -380,8 +380,13 @@ class PodBatch:
         # distinct request shapes / toleration lists are few per batch.
         tol_cache: dict[str, tuple[int, np.ndarray, np.ndarray]] = {}
         req_cache: dict[str, tuple[int, np.ndarray, np.ndarray]] = {}
-        #: per-pod equivalence-class ids (index into the unique-row lists)
-        #: — class-level host masks replace (P,N) broadcasts downstream.
+        #: per-pod equivalence-class ids (index into the unique-row
+        #: lists). These are the first two components of the backend's
+        #: CLASS-DICTIONARY plane key (ops/backend._prep_chunk): the
+        #: device ships (C,N) class planes + a (P,) index built on top
+        #: of them, and the host score memos key their per-class
+        #: normalization on the same ids — so the per-(P,N) broadcasts
+        #: AND the per-pod plane uploads both collapse to per-class.
         self.req_class = np.zeros((P,), dtype=np.int32)
         self.untol_class = np.zeros((P,), dtype=np.int32)
         self.req_rows: list[np.ndarray] = []

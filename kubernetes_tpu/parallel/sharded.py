@@ -129,7 +129,8 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                           used_nz_q, alloc_q, mask, static_scores,
                           fit_col_w, bal_col_mask, shape_u, shape_s,
                           w_fit, w_bal, strategy: str,
-                          shortlist_k: int = 0):
+                          shortlist_k: int = 0, rows=None, exc=None,
+                          row_req_q=None, row_req_nz_q=None):
     """Sequential-equivalent greedy with live re-scoring, node axis sharded.
 
     Per scan step: shard-local candidate (max score, min index among ties) →
@@ -146,14 +147,33 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
     global winner is bit-identical. The per-step ICI reduction was already
     O(1) scalars; what shrinks is each shard's local reduce, N/devices →
     K/devices + touched. A shard narrower than K+1 columns keeps the full
-    local scan (nothing to prune)."""
+    local scan (nothing to prune).
+
+    Class-dictionary planes (the r14 format): `mask`/`static_scores` may
+    carry C CLASS rows instead of P pod rows — pass `rows` ((P,) pod →
+    plane row), `row_req_q`/`row_req_nz_q` ((C,R) per-row request
+    vectors, used by the shard-local prefilter so it too runs over C
+    rows), and optionally `exc` ((P,) GLOBAL single-allowed-column
+    exception, -1 = none). Defaults reproduce the per-pod form
+    (rows = arange, row_req = req)."""
     n_shards = mesh.shape[NODES_AXIS]
     n_total = free_q.shape[0]
     assert n_total % n_shards == 0, (n_total, n_shards)
     local_n = n_total // n_shards
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
     run = _solver_fn(mesh, strategy, local_n, shortlist_k=max(k, 0))
-    return run(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+    p = req_q.shape[0]
+    if rows is None:
+        rows = jnp.arange(p, dtype=jnp.int32)
+    if exc is None:
+        exc = jnp.full((p,), -1, dtype=jnp.int32)
+    if row_req_q is None:
+        row_req_q = req_q
+    if row_req_nz_q is None:
+        row_req_nz_q = req_nz_q
+    return run(req_q, req_nz_q, jnp.asarray(rows), jnp.asarray(exc),
+               jnp.asarray(row_req_q), jnp.asarray(row_req_nz_q),
+               free_q, free_pods, used_nz_q, alloc_q,
                mask, static_scores, fit_col_w, bal_col_mask,
                jnp.asarray(shape_u), jnp.asarray(shape_s),
                jnp.float32(w_fit), jnp.float32(w_bal))
@@ -184,10 +204,12 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
 
     @jax.jit
     @partial(shard_map, mesh=mesh,
-             in_specs=(rep, rep, spec_nr, spec_n, spec_nr, spec_nr,
+             in_specs=(rep, rep, rep, rep, rep, rep,
+                       spec_nr, spec_n, spec_nr, spec_nr,
                        spec_pn, spec_pn, rep, rep, rep, rep, rep, rep),
              out_specs=rep, **_SHARD_MAP_KW)
-    def run(req_q, req_nz_q, free_q, free_pods, used_nz, alloc_q,
+    def run(req_q, req_nz_q, rows, exc, row_req_q, row_req_nz_q,
+            free_q, free_pods, used_nz, alloc_q,
             mask, static_sc, fit_col_w, bal_col_mask, shape_u, shape_s,
             w_fit, w_bal):
         shard = jnp.int32(0)
@@ -217,11 +239,13 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
 
         if shortlist_k:
             # Shard-local prefilter: chunk-start scores over MY columns,
-            # per-pod top-K + the (K+1)-th value as the local threshold.
-            fits0 = jnp.all(req_q[:, None, :] <= free_q[None, :, :],
+            # per-PLANE-ROW top-K + the (K+1)-th value as the local
+            # threshold — C class rows when the caller ships class
+            # planes, P pod rows in the identity form.
+            fits0 = jnp.all(row_req_q[:, None, :] <= free_q[None, :, :],
                             axis=-1) & (free_pods >= 1)[None, :]
             sc0 = kernels.chunk_start_scores(
-                alloc_q, used_nz, req_nz_q, static_sc, fit_col_w,
+                alloc_q, used_nz, row_req_nz_q, static_sc, fit_col_w,
                 bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy)
             vals, cand0 = lax.top_k(
                 jnp.where(mask & fits0, sc0, -jnp.inf), shortlist_k + 1)
@@ -231,24 +255,28 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
         def step(carry, inp):
             if shortlist_k:
                 free_q, free_pods, used_nz, touched, tidx, kstep = carry
-                req, req_nz, cand, t = inp
+                req, req_nz, row, e = inp
+                el = e - base  # exception column in LOCAL coordinates
+                cand = sl_cand[row]
+                t = sl_t[row]
                 cset = jnp.concatenate([cand, tidx])
                 valid = cset < local_n
                 ci = jnp.where(valid, cset, 0)
                 # (row, ci) element gathers off the closed-over local
                 # planes — an (local_n,)-wide xs row per step would put
                 # O(local_n) traffic back into the pruned scan.
-                live = static_sc[kstep, ci]
+                live = static_sc[row, ci]
                 live = live + w_fit * kernels.fit_score(
                     alloc_q[ci], used_nz[ci], req_nz[None, :], fit_col_w,
                     strategy, shape_u, shape_s)[0]
                 live = live + w_bal * kernels.balanced_allocation_score(
                     alloc_q[ci], used_nz[ci], req_nz[None, :],
                     bal_col_mask)[0]
-                live = jnp.where(touched[ci], live, sc0[kstep, ci])
-                fits = mask[kstep, ci] & valid \
+                live = jnp.where(touched[ci], live, sc0[row, ci])
+                fits = mask[row, ci] & valid \
                     & jnp.all(req[None, :] <= free_q[ci], axis=1) \
-                    & (free_pods[ci] >= 1)
+                    & (free_pods[ci] >= 1) \
+                    & ((e < 0) | (ci == el))
                 masked = jnp.where(fits, live, -jnp.inf)
                 sbest = jnp.max(masked)
                 any_l = sbest > -jnp.inf
@@ -259,18 +287,22 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
                     any_l,
                     (sbest > t) | ((sbest == t) & jnp.logical_not(w_t)),
                     t == -jnp.inf)
+
+                def fb(_):
+                    m = mask[row] & ((e < 0) | (iota == el))
+                    return local_full(req, req_nz, m, static_sc[row],
+                                      free_q, free_pods, used_nz)
+
                 lbest, lidx = lax.cond(
                     trusted,
                     lambda _: (sbest,
                                jnp.where(any_l, sidx, jnp.int32(local_n))),
-                    lambda _: local_full(req, req_nz, mask[kstep],
-                                         static_sc[kstep], free_q,
-                                         free_pods, used_nz),
-                    None)
+                    fb, None)
             else:
                 free_q, free_pods, used_nz = carry
-                req, req_nz, m, sc_static = inp
-                lbest, lidx = local_full(req, req_nz, m, sc_static,
+                req, req_nz, row, e = inp
+                m = mask[row] & ((e < 0) | (iota == (e - base)))
+                lbest, lidx = local_full(req, req_nz, m, static_sc[row],
                                          free_q, free_pods, used_nz)
             gbest = _reduce(lbest, lax.pmax)
             # Tie-break: lowest global index among shards holding gbest.
@@ -299,11 +331,9 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
                       jnp.zeros((local_n,), jnp.bool_),
                       jnp.full((p_pods,), local_n, jnp.int32),
                       jnp.int32(0))
-            xs = (req_q, req_nz_q, sl_cand, sl_t)
         else:
             carry0 = (free_q, free_pods, used_nz)
-            xs = (req_q, req_nz_q, mask, static_sc)
-        _, assign = lax.scan(step, carry0, xs)
+        _, assign = lax.scan(step, carry0, (req_q, req_nz_q, rows, exc))
         return assign
 
     _SOLVER_CACHE[key] = run
@@ -318,7 +348,9 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
                                      free_pods, used_nz_q, alloc_q, mask,
                                      static_scores, fit_col_w, bal_col_mask,
                                      shape_u, shape_s, w_fit, w_bal,
-                                     strategy: str, shortlist_k: int = 0):
+                                     strategy: str, shortlist_k: int = 0,
+                                     rows=None, exc=None,
+                                     row_req_q=None, row_req_nz_q=None):
     """Sequential-equivalent greedy over a (slice × nodes) mesh: the same
     solver body as `sharded_greedy_assign`, with the node dimension sharded
     over BOTH axes and the per-step argmax reduced hierarchically —
@@ -334,7 +366,18 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
     run = _solver_fn(mesh, strategy, local_n,
                      axes=(SLICE_AXIS, NODES_AXIS), shortlist_k=max(k, 0))
-    return run(req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+    p = req_q.shape[0]
+    if rows is None:
+        rows = jnp.arange(p, dtype=jnp.int32)
+    if exc is None:
+        exc = jnp.full((p,), -1, dtype=jnp.int32)
+    if row_req_q is None:
+        row_req_q = req_q
+    if row_req_nz_q is None:
+        row_req_nz_q = req_nz_q
+    return run(req_q, req_nz_q, jnp.asarray(rows), jnp.asarray(exc),
+               jnp.asarray(row_req_q), jnp.asarray(row_req_nz_q),
+               free_q, free_pods, used_nz_q, alloc_q,
                mask, static_scores, fit_col_w, bal_col_mask,
                jnp.asarray(shape_u), jnp.asarray(shape_s),
                jnp.float32(w_fit), jnp.float32(w_bal))

@@ -135,6 +135,15 @@ class WorkloadResult:
         self.solver_scan_width = 0
         self.solver_shortlist_pods_total = 0
         self.solver_shortlist_fallbacks_total = 0
+        #: Class-dictionary device-plane accounting over the measured
+        #: phase (r14): host-side chunk-prep wall (the prep-vs-solve
+        #: split per family), equivalence classes behind the latest
+        #: chunk's planes, plane payload bytes actually uploaded, and
+        #: pods that rode a per-pod fallback after class overflow.
+        self.prep_seconds_total = 0.0
+        self.plane_classes_per_chunk = 0
+        self.plane_bytes_uploaded_total = 0
+        self.class_split_fallback_pods = 0
         #: Sharded-control-plane accounting (ROADMAP #5): the run's
         #: shard count (1 = classic single store), per-shard host-prep
         #: rebuilds over the measured phase (the incremental path keeps
@@ -195,6 +204,10 @@ class WorkloadResult:
                 100.0 * (1.0 - self.solver_shortlist_fallbacks_total
                          / self.solver_shortlist_pods_total), 2)
             if self.solver_shortlist_pods_total else None,
+            "prep_seconds_total": round(self.prep_seconds_total, 3),
+            "plane_classes_per_chunk": self.plane_classes_per_chunk,
+            "plane_bytes_uploaded_total": self.plane_bytes_uploaded_total,
+            "class_split_fallback_pods": self.class_split_fallback_pods,
             "shard_count": self.shard_count,
             "shard_tensor_rebuilds_total": self.shard_tensor_rebuilds_total,
             "shard_solve_seconds": round(self.shard_solve_seconds, 3),
@@ -713,6 +726,9 @@ class PerfRunner:
             metrics.solve_duration.sum(),
             metrics.solver_shortlist_pods.value(),
             metrics.solver_shortlist_fallbacks.value(),
+            metrics.prep_duration.sum(),
+            metrics.plane_bytes.value(),
+            metrics.class_split_fallbacks.value(),
             sum(metrics.shard_tensor_rebuilds._values.values()),
             sum(metrics.shard_solve_seconds._values.values()),
             metrics.cross_shard_reductions.value(),
@@ -725,7 +741,8 @@ class PerfRunner:
          dispatched_base, checks_base, cache_hits_base, cache_miss_base,
          evals_base, audits_base,
          solve_chunks_base, solve_s_base, sl_pods_base,
-         sl_fall_base, shard_rb_base, shard_s_base, xshard_base,
+         sl_fall_base, prep_s_base, plane_b_base, class_fb_base,
+         shard_rb_base, shard_s_base, xshard_base,
          window_mark) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
@@ -775,6 +792,14 @@ class PerfRunner:
             metrics.solver_shortlist_pods.value() - sl_pods_base)
         result.solver_shortlist_fallbacks_total = int(
             metrics.solver_shortlist_fallbacks.value() - sl_fall_base)
+        result.prep_seconds_total = \
+            metrics.prep_duration.sum() - prep_s_base
+        result.plane_classes_per_chunk = int(
+            metrics.plane_classes.value())
+        result.plane_bytes_uploaded_total = int(
+            metrics.plane_bytes.value() - plane_b_base)
+        result.class_split_fallback_pods = int(
+            metrics.class_split_fallbacks.value() - class_fb_base)
         result.shard_count = int(getattr(backing, "node_shards", 1))
         result.shard_tensor_rebuilds_total = int(
             sum(metrics.shard_tensor_rebuilds._values.values())
