@@ -25,6 +25,16 @@ import sys
 
 REFERENCE_PODS_PER_SEC = 300.0
 
+#: default --churn rate sweeps (pods/s arrival): bracket the knee from
+#: a comfortable trickle to past the drain headline for the preset.
+PRESET_CHURN_RATES = {
+    "smoke": [50.0, 200.0, 800.0],
+    "1k": [100.0, 400.0, 1600.0],
+    "5k": [250.0, 1000.0, 4000.0],
+    "50k": [250.0, 1000.0, 4000.0],
+    "200k": [250.0, 1000.0, 4000.0],
+}
+
 PRESETS = {
     #       nodes, warmup pods, measured pods
     "smoke": (100, 200, 1000),
@@ -38,6 +48,72 @@ PRESETS = {
     # stores (store/sharded.py) — flagless; --shards/KTPU_SHARDS override.
     "200k": (200000, 500, 5000),
 }
+
+
+def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
+    """ChurnDay mode: rate sweep to the knee (+ optional fault row).
+
+    Headline = the knee (highest absorbed open-loop arrival rate) with
+    its exact p999; per-row details (p50/p99/p999, backlog growth,
+    fault/recovery records) go to stderr like the drain detail JSON."""
+    from kubernetes_tpu.perf.churn.driver import run_rate_sweep
+    from kubernetes_tpu.perf.scheduler_perf import PerfRunner
+    from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATES
+
+    rates = PRESET_CHURN_RATES[args.preset]
+    if args.churn_rates:
+        rates = [float(r) for r in args.churn_rates.split(",") if r]
+    fault = None
+    if args.churn_fault:
+        kind, _, at = args.churn_fault.partition("@")
+        fault = {"kind": kind, "at": float(at or 5.0)}
+    use_tpu = DEFAULT_FEATURE_GATES.enabled("TPUScorer")
+    if args.profile_dir:
+        print("warning: --profile-dir is not supported in --churn mode "
+              "(per-row runs would overwrite each other's traces); no "
+              "trace will be written", file=sys.stderr)
+    if not boundary and (args.policy_set or args.audit_level):
+        # Same "refuse to record a lie" guard as drain mode: the policy
+        # chain lives on the servers.
+        print("warning: --policy-set/--audit-level need "
+              "--through-apiserver; churn rows will evaluate NO "
+              "policies", file=sys.stderr)
+
+    def runner_factory():
+        be = None
+        if use_tpu:
+            from kubernetes_tpu.ops import TPUBackend
+            be = TPUBackend(max_batch=args.chunk)
+        return PerfRunner(backend=be, batch_size=batch if be else 1,
+                          through_apiserver=boundary, shards=shards,
+                          policy_count=args.policy_set,
+                          audit_rules=[{"level": args.audit_level}]
+                          if args.audit_level else None)
+
+    sweep = run_rate_sweep(
+        nodes=nodes, rates=rates, duration=args.churn_duration,
+        seed=args.churn_seed, model=args.churn_model,
+        warmup=args.churn_warmup, agents=args.churn_agents,
+        fault=fault, fault_rate=args.churn_fault_rate,
+        runner_factory=runner_factory, timeout=1800.0)
+    print(json.dumps({"churn": sweep, "preset": args.preset,
+                      "backend": args.backend}), file=sys.stderr)
+    knee = sweep["knee"]
+    value = knee["knee_rate"] or 0.0
+    out = {
+        "metric": f"churn_knee_arrival_rate_{args.preset}_{args.backend}"
+                  + (f"_apiserver_{args.transport}" if boundary else ""),
+        "value": value,
+        "unit": "pods/s",
+        "vs_baseline": round(value / REFERENCE_PODS_PER_SEC, 3),
+        "knee_p999_ms": knee["knee_p999_ms"],
+        "first_saturated_rate": knee["first_saturated_rate"],
+    }
+    if sweep["fault_row"] is not None:
+        out["fault_recovery_seconds_max"] = \
+            sweep["fault_row"]["churn_recovery_seconds_max"]
+    print(json.dumps(out))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -76,6 +152,39 @@ def main(argv=None) -> int:
                          "class planes entirely — the per-pod-plane "
                          "before/after sweep knob). Default: flagless "
                          "KTPU_CLASS_PAD (31)")
+    ap.add_argument("--churn", action="store_true",
+                    help="ChurnDay mode (perf/churn): instead of one "
+                         "bulk drain, sweep an OPEN-LOOP Poisson/burst/"
+                         "ramp arrival rate over the preset's nodes to "
+                         "find the knee; the headline becomes exact "
+                         "p50/p99/p999 attempt latency + knee rate, "
+                         "with queue growth as the saturation signal")
+    ap.add_argument("--churn-rates", default="",
+                    help="comma-separated arrival rates (pods/s) to "
+                         "sweep; default per preset")
+    ap.add_argument("--churn-duration", type=float, default=10.0,
+                    help="seconds of open-loop arrivals per rate row")
+    ap.add_argument("--churn-seed", type=int, default=17,
+                    help="arrival/fault timeline seed (same seed = "
+                         "bit-identical timelines)")
+    ap.add_argument("--churn-model",
+                    choices=["poisson", "burst", "ramp"],
+                    default="poisson")
+    ap.add_argument("--churn-warmup", type=int, default=300,
+                    help="drained warmup pods before the open-loop "
+                         "window (jit compile exclusion)")
+    ap.add_argument("--churn-fault", default="",
+                    help='inject a fault mid-wave, "kind@seconds" '
+                         '(e.g. "nodeDeath@5.0"): reruns one rate with '
+                         "agent-backed staging, the deterministic fault "
+                         "timeline, and time-to-recovery measured")
+    ap.add_argument("--churn-fault-rate", type=float, default=None,
+                    help="arrival rate for the fault scenario (default: "
+                         "the measured knee rate)")
+    ap.add_argument("--churn-agents", action="store_true",
+                    help="agent-backed staging for ALL churn rows (N "
+                         "hollow-kubelet NodeAgents instead of "
+                         "createNodes data staging)")
     ap.add_argument("--through-apiserver", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="cross the process boundary: workload writes, "
@@ -158,10 +267,13 @@ def main(argv=None) -> int:
     backend = None
     batch = 1
     if DEFAULT_FEATURE_GATES.enabled("TPUScorer"):
-        from kubernetes_tpu.ops import TPUBackend
-        backend = TPUBackend(max_batch=args.chunk)  # None = adaptive
         batch = args.batch_size
         args.backend = "tpu"
+        if not args.churn:
+            # Churn mode builds one fresh backend PER sweep row in its
+            # runner_factory; constructing one here would be dead work.
+            from kubernetes_tpu.ops import TPUBackend
+            backend = TPUBackend(max_batch=args.chunk)  # None = adaptive
     else:
         args.backend = "host"
 
@@ -189,7 +301,9 @@ def main(argv=None) -> int:
     boundary = False
     if args.through_apiserver:
         boundary = "wire" if args.transport == "wire" else True
-    elif args.policy_set or args.audit_level:
+    if args.churn:
+        return _run_churn(args, nodes, shards, boundary, batch)
+    if not args.through_apiserver and (args.policy_set or args.audit_level):
         # The policy chain lives on the servers: without the boundary
         # these flags measure nothing — refuse to record a lie.
         print("warning: --policy-set/--audit-level need "

@@ -150,17 +150,45 @@ class NodeAgent:
         self._tasks.append(self._watch_task)
         self._tasks.append(asyncio.ensure_future(self._lease_loop()))
 
-    async def stop(self) -> None:
+    async def stop(self, graceful: bool = True) -> None:
+        """Stop this agent — and only this agent (a shared store/wire
+        keeps serving its siblings).
+
+        graceful=True (orderly shutdown, the default): the watch and
+        lease loops are cancelled first, then in-flight per-pod workers
+        get a short drain window to land their current status write
+        before being cancelled themselves.
+
+        graceful=False (node DEATH — the churn battery's fault
+        primitive, SURVEY §5.3): every task is cancelled immediately,
+        mid-write, and awaited so nothing leaks; no further store
+        writes happen, and the Node and Lease objects are deliberately
+        left behind to go STALE — the nodelifecycle controller's grace
+        period, not this call, decides when the cluster notices the
+        death (lease expiry). Local pod/worker state is dropped too:
+        a killed agent cannot be restarted in place."""
         self._stopped = True
-        for t in [*self._tasks, *self._workers]:
+        loops = list(self._tasks)
+        for t in loops:
             t.cancel()
-        for t in [*self._tasks, *self._workers]:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        workers = list(self._workers)
+        if graceful and workers:
+            # Drain window: a worker mid-_mark_running finishes its
+            # write instead of aborting it (completion timers and other
+            # long sleepers are cancelled below when the window lapses).
+            _, pending = await asyncio.wait(workers, timeout=0.2)
+            workers = list(pending)
+        for t in workers:
+            t.cancel()
+        if loops or workers:
+            await asyncio.gather(*loops, *workers,
+                                 return_exceptions=True)
         self._tasks.clear()
         self._workers.clear()
+        if not graceful:
+            self._latest.clear()
+            self._armed.clear()
+            self._active.clear()
 
     async def _register_node(self) -> None:
         node = make_node(self.node_name, **self.node_template)

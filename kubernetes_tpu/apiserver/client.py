@@ -215,6 +215,28 @@ class RemoteStore:
                 headers=self._trace_headers()) as resp:
             return await self._json(resp)
 
+    async def dry_run(self, resource: str, obj: Mapping,
+                      operation: str = "update") -> dict:
+        """Server-side dry run (?dryRun=All — kubectl diff's seam): the
+        object flows through the FULL admission chain — mutating
+        webhooks, expression policies, validating webhooks — and the
+        admitted result comes back WITHOUT being persisted (no RV
+        assigned, no watch event)."""
+        params = {"dryRun": "All"}
+        if operation == "create":
+            ns = obj.get("metadata", {}).get("namespace")
+            async with self._sess().post(
+                    self._collection_url(resource, ns), json=dict(obj),
+                    params=params,
+                    headers=self._trace_headers()) as resp:
+                return await self._json(resp)
+        key = namespaced_name(obj)
+        async with self._sess().put(
+                self._item_url(resource, key), json=dict(obj),
+                params=params,
+                headers=self._trace_headers()) as resp:
+            return await self._json(resp)
+
     async def delete(self, resource: str, key: str, *,
                      uid: str | None = None) -> dict:
         kwargs = {}

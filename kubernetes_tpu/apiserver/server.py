@@ -845,6 +845,17 @@ class APIServer:
                         obj, resource, "create",
                         user=request.get("user"),
                         groups=self._request_groups(request))
+            if request.query.get("dryRun"):
+                # dryRun=All (kubectl diff's seam): the FULL admission
+                # chain ran above, and the store's mutators+validators
+                # run here too (defaulting becomes VISIBLE in the
+                # diff; an unpersistable object fails the dry run the
+                # way a real create would). Only uniqueness/RV checks
+                # are skipped — nothing persists, no watch event.
+                admit = getattr(self.store, "_admit", None)
+                if admit is not None:
+                    admit(resource, obj, "create")
+                return _object_response(request, obj, status=201)
             with self.tracer.span("store.create", resource=resource):
                 created = await self.store.create(resource, obj)
             return _object_response(request, created, status=201)
@@ -870,6 +881,13 @@ class APIServer:
                 obj = await self.admission.admit(
                     obj, resource, "update", user=request.get("user"),
                     groups=self._request_groups(request))
+            if request.query.get("dryRun"):
+                # Admission + store mutators/validators ran; the
+                # update is NOT persisted (see the POST dryRun note).
+                admit = getattr(self.store, "_admit", None)
+                if admit is not None:
+                    admit(resource, obj, "update")
+                return _object_response(request, obj)
             return _object_response(
                 request, await self.store.update(resource, obj))
         if request.method == "PATCH" and "apply-patch" in \

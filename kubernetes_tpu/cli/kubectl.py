@@ -2,7 +2,8 @@
 
 Parity target: staging/src/k8s.io/kubectl `pkg/cmd/` — the operational
 verbs an operator needs against the API server: get, describe, apply,
-create, patch, delete, scale, cordon/uncordon, drain, top, rollout.
+create, patch, diff, logs, delete, scale, cordon/uncordon, drain, top,
+rollout.
 Talks HTTP to an APIServer (`--server`), or to an in-process store when
 a caller passes one (tests, embedded tools).
 
@@ -10,6 +11,8 @@ a caller passes one (tests, embedded tools).
     python -m kubernetes_tpu.cli apply -f manifest.yaml
     python -m kubernetes_tpu.cli create -f manifest.yaml
     python -m kubernetes_tpu.cli patch pods web -p '{"spec": {...}}'
+    python -m kubernetes_tpu.cli diff -f manifest.yaml
+    python -m kubernetes_tpu.cli logs web-1
     python -m kubernetes_tpu.cli drain node-3
 """
 
@@ -226,18 +229,120 @@ async def cmd_apply(store, args, out) -> int:
             await store.create(resource, obj)
             print(f"{resource}/{meta.get('name')} created", file=out)
             continue
-        # apply = replace spec-ish fields, keep server-owned metadata.
-        merged = dict(current)
-        for k, v in obj.items():
-            if k != "metadata":
-                merged[k] = v
-        merged["metadata"] = dict(current["metadata"])
-        for k in ("labels", "annotations"):
-            if k in meta:
-                merged["metadata"][k] = meta[k]
-        await store.update(resource, merged)
+        await store.update(resource, _apply_merge(current, obj))
         print(f"{resource}/{meta.get('name')} configured", file=out)
     return rc
+
+
+def _apply_merge(current: dict, obj: dict) -> dict:
+    """Client-side apply merge: replace spec-ish fields, keep
+    server-owned metadata (shared by apply and diff)."""
+    merged = dict(current)
+    for k, v in obj.items():
+        if k != "metadata":
+            merged[k] = v
+    merged["metadata"] = dict(current["metadata"])
+    meta = obj.get("metadata") or {}
+    for k in ("labels", "annotations"):
+        if k in meta:
+            merged["metadata"][k] = meta[k]
+    return merged
+
+
+async def cmd_diff(store, args, out) -> int:
+    """kubectl diff (SURVEY §2.7): local manifests vs the server's live
+    objects, with the desired state routed through the server's DRY-RUN
+    admission chain (?dryRun=All) when the store supports it — the diff
+    shows what admission mutation/defaulting would ACTUALLY persist,
+    not the raw manifest. rc 0 = no differences, 1 = differences found,
+    2 = error — e.g. admission REJECTED the desired state (kubectl's
+    exit-code contract: >1 means the diff itself failed)."""
+    import difflib
+
+    import yaml
+    differs = False
+    errored = False
+    for obj in _load_manifests(args.filename):
+        resource = _kind_map(store).get(obj.get("kind", ""))
+        if resource is None:
+            print(f"Error: unknown kind {obj.get('kind')!r}",
+                  file=sys.stderr)
+            errored = True
+            continue
+        meta = obj.setdefault("metadata", {})
+        if not _cluster_scoped(store, resource):
+            meta.setdefault("namespace", args.namespace)
+        name = meta.get("name", "")
+        key = _key(store, resource, name, meta.get("namespace",
+                                                   args.namespace))
+        try:
+            live = await store.get(resource, key)
+        except NotFound:
+            live = None
+        desired = obj if live is None else _apply_merge(live, obj)
+        dry = getattr(store, "dry_run", None)
+        if dry is not None:
+            try:
+                desired = await dry(
+                    resource, desired,
+                    "create" if live is None else "update")
+            except StoreError as e:
+                print(f"Error: {resource}/{name} rejected by the "
+                      f"dry-run admission chain: {e}", file=sys.stderr)
+                errored = True
+                continue
+        a = yaml.safe_dump(live, sort_keys=True).splitlines() if live \
+            else []
+        b = yaml.safe_dump(desired, sort_keys=True).splitlines()
+        diff = list(difflib.unified_diff(
+            a, b, fromfile=f"LIVE/{resource}/{name}",
+            tofile=f"MERGED/{resource}/{name}", lineterm=""))
+        if diff:
+            differs = True
+            for line in diff:
+                print(line, file=out)
+    if errored:
+        return 2
+    return 1 if differs else 0
+
+
+async def cmd_logs(store, args, out) -> int:
+    """kubectl logs, minimal read path: there is no container runtime,
+    so the "log" is reconstructed from the agent-recorded status — the
+    hollow kubelet's phase/podIP/condition writes (agent/agent.py
+    _mark_running) — followed by the pod's recorded events."""
+    key = _key(store, "pods", args.name, args.namespace)
+    try:
+        pod = await store.get("pods", key)
+    except NotFound as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    meta = pod.get("metadata") or {}
+    spec = pod.get("spec") or {}
+    status = pod.get("status") or {}
+    if meta.get("creationTimestamp"):
+        print(f"created {meta['creationTimestamp']}", file=out)
+    if spec.get("nodeName"):
+        print(f"scheduled to node {spec['nodeName']}", file=out)
+    for c in status.get("conditions") or []:
+        print(f"condition {c.get('type')}={c.get('status')}", file=out)
+    if status.get("podIP"):
+        print(f"podIP {status['podIP']}", file=out)
+    print(f"phase {status.get('phase', 'Unknown')}", file=out)
+    try:
+        events = (await store.list("events")).items
+    except StoreError:
+        events = []
+    for e in events:
+        inv = e.get("involvedObject") or {}
+        if inv.get("kind") not in (None, "Pod") or \
+                inv.get("name") != args.name:
+            continue
+        if inv.get("namespace", args.namespace) != args.namespace:
+            continue
+        print(f"event {e.get('type', '')} {e.get('reason', '')}: "
+              f"{e.get('message', '')}", file=out)
+    return 0
 
 
 async def cmd_create(store, args, out) -> int:
@@ -566,6 +671,14 @@ def build_parser() -> argparse.ArgumentParser:
     cr = sub.add_parser("create")
     cr.add_argument("-f", "--filename", required=True)
     cr.set_defaults(fn=cmd_create)
+
+    df = sub.add_parser("diff")
+    df.add_argument("-f", "--filename", required=True)
+    df.set_defaults(fn=cmd_diff)
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    lg.set_defaults(fn=cmd_logs)
 
     pa = sub.add_parser("patch")
     pa.add_argument("resource")

@@ -36,11 +36,18 @@ class NodeLifecycleController(Controller):
                  node_monitor_period: float = 1.0,
                  node_monitor_grace_period: float = 4.0,
                  default_toleration_seconds: float = 3.0,
+                 toleration_seconds_cap: float | None = None,
                  clock=time.monotonic):
         super().__init__(store)
         self.monitor_period = node_monitor_period
         self.grace_period = node_monitor_grace_period
         self.default_toleration_seconds = default_toleration_seconds
+        #: upper bound applied to FINITE per-pod tolerationSeconds (the
+        #: admission default injects 300s on every pod); fault-injection
+        #: harnesses set this to accelerate the eviction clock the same
+        #: way they shorten the grace period. None = honor pod values;
+        #: tolerate-forever (no seconds) is never overridden.
+        self.toleration_seconds_cap = toleration_seconds_cap
         self.clock = clock
         #: node -> monotonic time of last observed lease renewal
         self._last_heartbeat: dict[str, float] = {}
@@ -148,11 +155,16 @@ class NodeLifecycleController(Controller):
         matching toleration wins; absent one, the injected default applies
         (defaulttolerationseconds admission plugin)."""
         taint = {"key": TAINT_UNREACHABLE, "effect": TAINT_NO_EXECUTE}
+        cap = self.toleration_seconds_cap
         for tol in pod.get("spec", {}).get("tolerations") or []:
             if toleration_tolerates_taint(tol, taint):
                 secs = tol.get("tolerationSeconds")
-                return None if secs is None else float(secs)
-        return self.default_toleration_seconds
+                if secs is None:
+                    return None  # tolerates forever; the cap never applies
+                return float(secs) if cap is None \
+                    else min(float(secs), cap)
+        return self.default_toleration_seconds if cap is None \
+            else min(self.default_toleration_seconds, cap)
 
     async def _evict_after(self, key: str, node: str, delay: float) -> None:
         try:

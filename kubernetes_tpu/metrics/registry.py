@@ -327,6 +327,42 @@ class WatchCacheMetrics:
             registry._metrics.setdefault(m.name, m)
 
 
+class ChurnMetrics:
+    """Churn-battery counters (perf/churn — ROADMAP #2's scenario
+    battery): open-loop arrivals enqueued per model, fault-timeline
+    events injected per kind, and summed time-to-recovery per kind.
+    The injector/driver increment these; the bench detail JSON reports
+    the per-phase deltas, and `register_into` surfaces them through a
+    server registry's /metrics render (the WatchMetrics pattern: same
+    objects, one truth)."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or Registry()
+        self.registry = r
+        self.arrivals = r.counter(
+            "churn_arrivals_total",
+            "Open-loop pod arrivals enqueued by the churn driver",
+            labels=("model",))
+        self.faults_injected = r.counter(
+            "churn_faults_injected_total",
+            "Fault-timeline events injected by the churn battery",
+            labels=("kind",))
+        self.recovery_seconds = r.counter(
+            "churn_recovery_seconds_total",
+            "Summed time-to-recovery of disruptive injected faults "
+            "(displaced pods rescheduled, backlog under threshold)",
+            labels=("kind",))
+        self.backlog_peak = r.gauge(
+            "churn_queue_backlog_peak",
+            "Peak scheduler queue backlog observed during the latest "
+            "open-loop churn phase")
+
+    def register_into(self, registry: Registry) -> None:
+        for m in (self.arrivals, self.faults_injected,
+                  self.recovery_seconds, self.backlog_peak):
+            registry._metrics.setdefault(m.name, m)
+
+
 #: verbs counted as mutating for apiserver_current_inflight_requests'
 #: request_kind label (the reference's mutating/readOnly split).
 _MUTATING_VERBS = frozenset(("create", "update", "patch", "delete"))

@@ -28,7 +28,12 @@ Nodes in place of kwok-style data staging, so the run carries the
 control-plane cost of N watch consumers + mark-Running writes + lease
 heartbeats), relistStorm (every started agent tears down its watch and
 cold-start relists AT ONCE — the watch-cache tier's measured scenario:
-N reads of one shared snapshot instead of N store scans).
+N reads of one shared snapshot instead of N store scans), churnOpenLoop
+(the ChurnDay battery, perf/churn: a TIMED open-loop arrival window —
+seeded Poisson/burst/ramp pod arrivals on an absolute clock with an
+optional deterministic fault timeline injected mid-wave; saturation
+shows up as queue growth, the exact p50/p99/p999 attempt percentiles
+are the headline, and disruptive faults report time-to-recovery).
 Metrics collected over the measured phase:
 SchedulingThroughput (pods/s), scheduling_attempt_duration percentiles
 (p50/p90/p99 from the scheduler's own histogram — SURVEY §5.5 names),
@@ -156,6 +161,32 @@ class WorkloadResult:
         #: startAgents opcode wall (the cold-start fleet boot measured
         #: by the agent-batching satellite; 0.0 when no agents started).
         self.agent_start_seconds = 0.0
+        #: ChurnDay open-loop battery (perf/churn): the measured phase
+        #: is a TIMED arrival-process window, not a drained bulk —
+        #: offered vs achieved rate proves the loop stayed open,
+        #: backlog growth is the saturation witness (the knee signal),
+        #: and the exact attempt percentiles above are the headline.
+        self.churn_offered_rate = 0.0
+        self.churn_achieved_rate = 0.0
+        self.churn_arrival_model = ""
+        self.churn_arrivals_total = 0
+        self.churn_duration_s = 0.0
+        self.churn_backlog_peak = 0
+        self.churn_backlog_final = 0
+        self.churn_pending_final: dict[str, int] = {}
+        #: None = no churn phase ran; else the is_saturated verdict.
+        self.churn_saturated: bool | None = None
+        #: open-loop honesty counters: arrivals fired >50ms late, and
+        #: creates the transport backstop forced to serialize.
+        self.churn_late_arrivals = 0
+        self.churn_throttled_creates = 0
+        self.churn_create_errors = 0
+        self.churn_create_drain_s = 0.0
+        #: fault-injection records (timeline order) + per-kind counts +
+        #: the worst measured time-to-recovery.
+        self.churn_faults: list[dict] = []
+        self.churn_faults_injected: dict[str, int] = {}
+        self.churn_recovery_seconds_max: float | None = None
 
     def as_dict(self) -> dict:
         import math
@@ -213,6 +244,22 @@ class WorkloadResult:
             "shard_solve_seconds": round(self.shard_solve_seconds, 3),
             "cross_shard_reductions_total": self.cross_shard_reductions_total,
             "agent_start_seconds": round(self.agent_start_seconds, 3),
+            "churn_offered_rate": round(self.churn_offered_rate, 2),
+            "churn_achieved_rate": round(self.churn_achieved_rate, 2),
+            "churn_arrival_model": self.churn_arrival_model,
+            "churn_arrivals_total": self.churn_arrivals_total,
+            "churn_duration_s": round(self.churn_duration_s, 3),
+            "churn_backlog_peak": self.churn_backlog_peak,
+            "churn_backlog_final": self.churn_backlog_final,
+            "churn_pending_final": dict(self.churn_pending_final),
+            "churn_saturated": self.churn_saturated,
+            "churn_late_arrivals": self.churn_late_arrivals,
+            "churn_throttled_creates": self.churn_throttled_creates,
+            "churn_create_errors": self.churn_create_errors,
+            "churn_create_drain_s": round(self.churn_create_drain_s, 3),
+            "churn_faults": list(self.churn_faults),
+            "churn_faults_injected": dict(self.churn_faults_injected),
+            "churn_recovery_seconds_max": self.churn_recovery_seconds_max,
         }
 
 
@@ -605,6 +652,19 @@ class PerfRunner:
                     result.relist_storm_cache_hits = int(h1 - h0)
                     result.relist_storm_cache_misses = int(m1 - m0)
 
+                elif opcode == "churnOpenLoop":
+                    # ChurnDay (perf/churn): a TIMED open-loop arrival
+                    # window — pods enqueue at the process's rate on an
+                    # absolute clock whatever the scheduler does, with
+                    # an optional deterministic fault timeline injected
+                    # mid-wave. No trailing barrier belongs after this
+                    # op: a saturated run deliberately ends with unbound
+                    # pods (that backlog IS the measurement).
+                    created_total += await self._run_churn_phase(
+                        op, params, result, metrics, backing, store,
+                        sched, factory, agents, bound_keys, pod_seq)
+                    pod_seq += result.churn_arrivals_total
+
                 elif opcode == "barrier":
                     await self._wait_bound(bound_keys, created_total, deadline)
 
@@ -667,6 +727,139 @@ class PerfRunner:
         result.events_emitted_total = sched.recorder.emitted
         result.events_dropped_total = sched.recorder.dropped
         return result
+
+    async def _run_churn_phase(self, op: Mapping, params: Mapping[str, Any],
+                               result: WorkloadResult, metrics, backing,
+                               store, sched, factory, agents: list,
+                               bound_keys: set, pod_seq: int) -> int:
+        """Execute one churnOpenLoop op; returns the net pod-count delta
+        (arrivals + fault creates − fault deletes) for created_total."""
+        from kubernetes_tpu.metrics.registry import ChurnMetrics
+        from kubernetes_tpu.perf.churn import (
+            ChurnDriver,
+            FaultInjector,
+            build_fault_timeline,
+            is_saturated,
+            make_arrival_process,
+        )
+        duration = float(_subst(op.get("duration", 5.0), params))
+        seed = int(_subst(op.get("seed", 0), params))
+        arrival = {k: _subst(v, params)
+                   for k, v in (op.get("arrival")
+                                or {"model": "poisson", "rate": 100}).items()}
+        process = make_arrival_process(arrival, seed=seed)
+        churn_metrics = ChurnMetrics(metrics.registry)
+        measured = bool(op.get("collectMetrics"))
+        tmpl = {**DEFAULT_POD_TEMPLATE, **(op.get("podTemplate") or {})}
+        pod_ns = tmpl.get("namespace", "default")
+
+        async def create_arrival(name: str, template: dict | None = None):
+            await store.create("pods", make_pod(
+                name, **(template if template is not None
+                         else copy.deepcopy(tmpl))))
+
+        driver = ChurnDriver(
+            process, duration,
+            create_pod=create_arrival,
+            backlog_stats=sched.queue.stats,
+            # Keep scheduler_pending_pods{queue} fresh under saturation
+            # (the scheduler only refreshes it per popped batch).
+            on_backlog=metrics.set_pending,
+            metrics=churn_metrics,
+            name_prefix=f"churn{pod_seq}")
+
+        injector = None
+        timeline = []
+        nlc = None
+        fault_specs = op.get("faults") or []
+        if fault_specs:
+            timeline = build_fault_timeline(
+                [{k: _subst(v, params) for k, v in f.items()}
+                 for f in fault_specs],
+                seed=seed,
+                node_names=[a.node_name for a in agents])
+            injector = FaultInjector(
+                store=store, agents=agents, bound_keys=bound_keys,
+                create_pod=create_arrival,
+                backlog_fn=sched.queue.backlog_depth,
+                metrics=churn_metrics, pod_template=tmpl,
+                recovery_threshold=int(_subst(
+                    op.get("recoveryThreshold", 10), params)),
+                recovery_timeout=float(_subst(
+                    op.get("recoveryTimeout", 60.0), params)),
+                namespace=pod_ns)
+            if any(ev.kind == "nodeDeath" for ev in timeline):
+                # Node death needs the lease-expiry machinery live: a
+                # killed agent's Lease goes stale, the controller
+                # taints unreachable after the grace period, and the
+                # NoExecute manager evicts (SURVEY §5.3).
+                from kubernetes_tpu.controllers.nodelifecycle import (
+                    NodeLifecycleController,
+                )
+                tol = float(_subst(op.get("tolerationSeconds", 0.25),
+                                   params))
+                nlc = NodeLifecycleController(
+                    store,
+                    node_monitor_period=0.1,
+                    node_monitor_grace_period=float(_subst(
+                        op.get("nodeGracePeriod", 1.0), params)),
+                    default_toleration_seconds=tol,
+                    # The admission default stamps 300s on every pod;
+                    # the scenario's toleration knob caps it so the
+                    # eviction clock runs at bench speed.
+                    toleration_seconds_cap=tol)
+                nlc.setup(factory)
+                factory.informer("leases").start()
+                await factory.informer("leases").wait_for_sync()
+                nlc.start()
+
+        window = self._begin_measure(metrics, backing) if measured else None
+        try:
+            t0 = time.monotonic()
+            inj_task = None
+            if injector is not None:
+                inj_task = asyncio.ensure_future(
+                    injector.run(timeline, t0))
+            phase = await driver.run(t0)
+            if inj_task is not None:
+                await inj_task
+                await injector.drain()
+        finally:
+            if nlc is not None:
+                await nlc.stop()
+        if measured:
+            self._end_measure(result, metrics, backing, window,
+                              phase.arrivals_total)
+        result.churn_offered_rate = phase.offered_rate
+        result.churn_achieved_rate = phase.achieved_rate
+        result.churn_arrival_model = phase.arrival_model
+        result.churn_arrivals_total = phase.arrivals_total
+        result.churn_duration_s = phase.duration
+        result.churn_backlog_peak = phase.backlog_peak
+        result.churn_backlog_final = phase.backlog_final
+        result.churn_pending_final = dict(phase.pending_final)
+        result.churn_saturated = is_saturated(
+            phase.arrivals_total, phase.backlog_final,
+            float(_subst(op.get("saturationFrac", 0.2), params)),
+            offered_rate=phase.offered_rate,
+            achieved_rate=phase.achieved_rate)
+        result.churn_late_arrivals = phase.late_arrivals
+        result.churn_throttled_creates = phase.throttled_creates
+        result.churn_create_errors = phase.create_errors
+        result.churn_create_drain_s = phase.create_drain_s
+        net = phase.arrivals_total
+        if injector is not None:
+            result.churn_faults = list(injector.results)
+            counts: dict[str, int] = {}
+            for rec in injector.results:
+                counts[rec["kind"]] = counts.get(rec["kind"], 0) + 1
+            result.churn_faults_injected = counts
+            recoveries = [rec["recovery_s"] for rec in injector.results
+                          if rec.get("recovery_s") is not None]
+            if recoveries:
+                result.churn_recovery_seconds_max = max(recoveries)
+            net += injector.net_created
+        return net
 
     async def _install_policies(self, backing) -> None:
         """The overhead knob: N pass-through pod policies + bindings

@@ -331,7 +331,17 @@ class SchedulingQueue:
             "backoff": len(self._backoff),
             "unschedulable": len(self._unschedulable),
             "gated": len(self._gated),
+            "in_flight": len(self._in_flight),
         }
+
+    def backlog_depth(self) -> int:
+        """Total pods the scheduler still owes work for (every tier plus
+        in-flight cycles) — the open-loop churn battery's saturation
+        signal: under sustained arrivals this growing without bound IS
+        the knee, where a drain bench would only show a slower clock."""
+        return (len(self._active) + len(self._backoff)
+                + len(self._unschedulable) + len(self._gated)
+                + len(self._in_flight))
 
     def has_parked(self) -> bool:
         """Anything a cluster event could wake (gated or unschedulable)."""

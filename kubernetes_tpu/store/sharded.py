@@ -223,6 +223,12 @@ class ShardedNodeStore:
         for s in self.shards:
             s.register_mutator(resource, fn, on=on)
 
+    def _admit(self, resource: str, obj: dict, op: str = "create") -> None:
+        """Mutators + validators without a write (the apiserver's
+        ?dryRun=All path): registration fans out identically to every
+        shard, so shard 0 is authoritative."""
+        self.meta._admit(resource, obj, op)
+
     def add_event_sink(self, sink) -> None:
         for s in self.shards:
             s.add_event_sink(sink)

@@ -1,0 +1,119 @@
+"""ChurnDay tier-1 smoke: a tiny knee sweep + a node-death scenario run
+end to end, pinning the bench detail-JSON churn schema (ISSUE r15 CI
+satellite). Kept small: ~50 nodes, sub-second open-loop windows."""
+
+import asyncio
+
+from kubernetes_tpu.perf import PerfRunner
+from kubernetes_tpu.perf.churn.driver import run_rate_sweep
+
+#: every churn field the bench detail JSON must carry (schema assertion
+#: extended to the new battery — sharded/residency smokes pin theirs).
+CHURN_DETAIL_KEYS = {
+    "churn_offered_rate", "churn_achieved_rate", "churn_arrival_model",
+    "churn_arrivals_total", "churn_duration_s", "churn_backlog_peak",
+    "churn_backlog_final", "churn_pending_final", "churn_saturated",
+    "churn_late_arrivals", "churn_throttled_creates",
+    "churn_create_errors", "churn_create_drain_s", "churn_faults",
+    "churn_faults_injected", "churn_recovery_seconds_max",
+}
+
+
+class TestChurnSmoke:
+    def test_sweep_finds_knee_and_fault_recovers(self):
+        """One tiny sweep bracketing the knee (a trickle the host path
+        absorbs + a flood it can't) plus a nodeDeath scenario mid-wave:
+        knee/p999 fields present, fault injection FIRES, recovery
+        measured, and the detail JSON carries the full churn schema."""
+        sweep = run_rate_sweep(
+            nodes=50, rates=[50.0, 6000.0], duration=0.8, warmup=20,
+            seed=11, fault={"kind": "nodeDeath", "at": 0.3},
+            fault_rate=40.0, grace=1.0, toleration=0.1,
+            recovery_timeout=30.0, timeout=120.0)
+
+        rows = sweep["rows"]
+        assert len(rows) == 2
+        for row in rows:
+            assert CHURN_DETAIL_KEYS <= set(row)
+            # p50/p99/p999 are the battery's headline: exact recorder
+            # values, present per row.
+            assert row["attempt_percentiles_exact"] is True
+            for k in ("attempt_p50_ms", "attempt_p99_ms",
+                      "attempt_p999_ms"):
+                assert row[k] is not None and row[k] > 0
+            # Open-loop COUNT invariant: every seeded arrival fired —
+            # saturation may slip the clock (self-reported via
+            # late_arrivals/achieved_rate) but never drops arrivals.
+            from kubernetes_tpu.perf.churn import PoissonArrivals
+            expected = len(PoissonArrivals(
+                row["churn_offered_rate"], seed=11).timeline(0.8))
+            assert row["churn_arrivals_total"] == expected
+        # The trickle row also tracks the offered rate in wall time.
+        assert rows[0]["churn_achieved_rate"] > \
+            0.7 * rows[0]["churn_offered_rate"]
+
+        knee = sweep["knee"]
+        assert knee["knee_rate"] == 50.0
+        assert knee["knee_p999_ms"] is not None
+        assert knee["first_saturated_rate"] == 6000.0
+        assert rows[1]["churn_saturated"] is True
+        assert rows[1]["churn_backlog_final"] > 16
+
+        fr = sweep["fault_row"]
+        assert fr is not None
+        assert fr["churn_faults_injected"] == {"nodeDeath": 1}
+        (fault,) = fr["churn_faults"]
+        assert fault["kind"] == "nodeDeath"
+        assert fault["recovered"] is True
+        assert fault["recovery_s"] is not None and fault["recovery_s"] > 0
+        assert fr["churn_recovery_seconds_max"] == fault["recovery_s"]
+
+    def test_repo_config_has_churn_families(self):
+        """ChurnDay ships with ≥3 knee-sweep rows plus a fault family."""
+        from kubernetes_tpu.perf.scheduler_perf import load_config
+        cfg = load_config(
+            "kubernetes_tpu/perf/config/performance-config.yaml")
+        fams = {c["name"]: c for c in cfg}
+        day = fams["ChurnDay"]
+        assert len(day["workloads"]) >= 3
+        rates = {w["params"]["rate"] for w in day["workloads"]}
+        assert len(rates) >= 3  # a real sweep, not one rate repeated
+        ops = [op["opcode"] for op in day["workloadTemplate"]]
+        assert "churnOpenLoop" in ops
+        faults = fams["ChurnDayFaults"]
+        churn_op = next(op for op in faults["workloadTemplate"]
+                        if op["opcode"] == "churnOpenLoop")
+        assert any(f["kind"] == "nodeDeath" for f in churn_op["faults"])
+        # lease renewals must outpace the grace period or healthy nodes
+        # flap unreachable (the config bug this battery's bring-up hit).
+        lease = next(op for op in faults["workloadTemplate"]
+                     if op["opcode"] == "startAgents")["leasePeriod"]
+        for w in faults["workloads"]:
+            assert w["params"]["grace"] >= 2 * lease
+
+    def test_gang_arrival_fault_collides_with_preemption(self):
+        """gangArrival mid-wave: a high-priority gang lands at once on a
+        full cluster and must displace filler load (the r6 preemption
+        path active inside the open-loop run)."""
+        template = [
+            {"opcode": "createNodes", "count": 4,
+             "nodeTemplate": {"allocatable":
+                              {"cpu": "4", "memory": "16Gi",
+                               "pods": "32"}}},
+            {"opcode": "createPods", "count": 14,
+             "podTemplate": {"priority": 0, "requests": {"cpu": "1"}}},
+            {"opcode": "barrier"},
+            {"opcode": "churnOpenLoop", "collectMetrics": True,
+             "arrival": {"model": "poisson", "rate": 10},
+             "duration": 1.2, "seed": 5,
+             "recoveryTimeout": 30.0,
+             "faults": [{"at": 0.2, "kind": "gangArrival", "count": 4,
+                         "podTemplate": {"priority": 1000,
+                                         "requests": {"cpu": "1"}}}]},
+        ]
+        res = asyncio.run(PerfRunner().run(template, {}, timeout=90.0))
+        d = res.as_dict()
+        assert d["churn_faults_injected"] == {"gangArrival": 1}
+        (fault,) = d["churn_faults"]
+        assert fault["replacements"] == 4
+        assert fault["recovered"] is True

@@ -368,3 +368,141 @@ class TestRolloutAndTop:
             assert "busy" in text and "500m" in text and "n0" in text
             store.stop()
         run(body())
+
+
+class TestLogsDiff:
+    """kubectl logs (agent-recorded status read path) + kubectl diff
+    (local vs server through the dry-run admission chain) — SURVEY §2.7
+    carryovers."""
+
+    def test_logs_reads_agent_recorded_status(self):
+        async def body():
+            store = await seeded_store()
+            # The hollow kubelet's status writes: phase/podIP/conditions
+            # (agent/agent.py _mark_running) are the log's source.
+            def mark(p):
+                p["status"].update({
+                    "podIP": "10.20.0.1",
+                    "conditions": [{"type": "Ready", "status": "True"}]})
+                return p
+            await store.guaranteed_update("pods", "default/web-1", mark)
+            await store.create("events", {
+                "kind": "Event", "metadata": {"name": "ev-log",
+                                              "namespace": "default"},
+                "involvedObject": {"kind": "Pod", "name": "web-1",
+                                   "namespace": "default"},
+                "type": "Normal", "reason": "Scheduled",
+                "message": "assigned default/web-1 to n0"})
+            rc, out = await _cli(store, "logs", "web-1")
+            assert rc == 0
+            assert "scheduled to node n0" in out
+            assert "podIP 10.20.0.1" in out
+            assert "condition Ready=True" in out
+            assert "phase Running" in out
+            assert "event Normal Scheduled" in out
+            store.stop()
+        run(body())
+
+    def test_logs_missing_pod_errors(self):
+        async def body():
+            store = await seeded_store()
+            rc, _ = await _cli(store, "logs", "nope")
+            assert rc == 1
+            store.stop()
+        run(body())
+
+    def test_diff_in_process(self):
+        async def body(tmp_path):
+            store = await seeded_store()
+            live = await store.get("pods", "default/web-1")
+            # Identical manifest (the live object itself) → no diff.
+            same = tmp_path / "same.yaml"
+            same.write_text(yaml.safe_dump(live))
+            rc, out = await _cli(store, "diff", "-f", str(same))
+            assert rc == 0 and out == ""
+            # A label change → unified diff, rc 1, nothing persisted.
+            changed = dict(live, metadata={**live["metadata"],
+                                           "labels": {"app": "web2"}})
+            mod = tmp_path / "mod.yaml"
+            mod.write_text(yaml.safe_dump(changed))
+            rc, out = await _cli(store, "diff", "-f", str(mod))
+            assert rc == 1
+            assert "-    app: web" in out and "+    app: web2" in out
+            still = await store.get("pods", "default/web-1")
+            assert still["metadata"]["labels"] == {"app": "web"}
+            store.stop()
+
+        import tempfile
+        from pathlib import Path
+        with tempfile.TemporaryDirectory() as d:
+            run(body(Path(d)))
+
+    def test_diff_through_dry_run_admission_chain(self):
+        """Against a live server the desired state flows through
+        ?dryRun=All — the FULL expression-policy admission chain runs,
+        nothing persists (RV unchanged), and a policy that rejects the
+        desired state fails the diff with rc 2."""
+        async def body(tmp_path):
+            from kubernetes_tpu.api.types import (
+                make_validating_admission_policy,
+                make_vap_binding,
+            )
+            from kubernetes_tpu.apiserver.admission import (
+                WebhookAdmission,
+            )
+            from kubernetes_tpu.apiserver.client import RemoteStore
+            from kubernetes_tpu.apiserver.server import APIServer
+            from kubernetes_tpu.policy import PolicyEngine
+            store = new_cluster_store()
+            install_core_validation(store)
+            adm = WebhookAdmission(store,
+                                   policy_engine=PolicyEngine(store))
+            srv = APIServer(store, admission=adm)
+            await srv.start()
+            rs = RemoteStore(srv.url)
+            await rs.create("pods", make_pod("web", labels={"app": "web"}))
+            live = await store.get("pods", "default/web")
+            rv0 = live["metadata"]["resourceVersion"]
+            changed = dict(live, metadata={**live["metadata"],
+                                           "labels": {"app": "web",
+                                                      "tier": "fe"}})
+            mod = tmp_path / "mod.yaml"
+            mod.write_text(yaml.safe_dump(changed))
+            rc, out = await _cli(rs, "diff", "-f", str(mod))
+            assert rc == 1
+            assert "+    tier: fe" in out
+            # Dry run: the server persisted NOTHING.
+            after = await store.get("pods", "default/web")
+            assert after["metadata"]["resourceVersion"] == rv0
+            assert "tier" not in after["metadata"]["labels"]
+            # A policy rejecting the desired state fails the diff.
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("no-tier", [
+                    {"expression":
+                     "not has(object.metadata.labels) or "
+                     "not ('tier' in object.metadata.labels)",
+                     "message": "tier label forbidden"}]))
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("no-tier-b", "no-tier"))
+            rc, _ = await _cli(rs, "diff", "-f", str(mod))
+            assert rc == 2
+            # Store-level validation runs on the dry-run path too: an
+            # unpersistable manifest (bad resource quantity) must fail
+            # the diff (rc 2), not diff clean and fail at apply time.
+            bad = dict(live)
+            bad["spec"] = {**live["spec"], "containers": [
+                {"name": "main", "image": "app",
+                 "resources": {"requests": {"cpu": "not-a-cpu"}}}]}
+            badf = tmp_path / "bad.yaml"
+            badf.write_text(yaml.safe_dump(bad))
+            rc, _ = await _cli(rs, "diff", "-f", str(badf))
+            assert rc == 2
+            await rs.close()
+            await srv.stop()
+            store.stop()
+
+        import tempfile
+        from pathlib import Path
+        with tempfile.TemporaryDirectory() as d:
+            run(body(Path(d)))
