@@ -116,6 +116,81 @@ def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
     return 0
 
 
+def _run_serve(args, nodes: int, warmup: int, measured: int, shards,
+               boundary, batch: int) -> int:
+    """--serve mode: the online-serving headline pair IN ONE RUN —
+    (a) the unchanged bulk-drain throughput of the preset, then
+    (b) a steady-state single-pod trickle (open-loop arrivals at
+    --serve-rate, default the r15 worst-case 250/s) whose EXACT
+    p50/p99/p999 attempt percentiles (r11 WindowedLatencyRecorder) are
+    the serving tier's figure of merit. Fresh runner per phase so the
+    drain's warmed chunk programs can't subsidize the serve numbers or
+    vice versa."""
+    from kubernetes_tpu.perf.scheduler_perf import PerfRunner
+    from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATES
+
+    use_tpu = DEFAULT_FEATURE_GATES.enabled("TPUScorer")
+    if not boundary and (args.policy_set or args.audit_level):
+        # Same "refuse to record a lie" guard as the drain/churn modes:
+        # the policy chain lives on the servers.
+        print("warning: --policy-set/--audit-level need "
+              "--through-apiserver; serve rows will evaluate NO "
+              "policies", file=sys.stderr)
+
+    def make_runner():
+        be = None
+        if use_tpu:
+            from kubernetes_tpu.ops import TPUBackend
+            be = TPUBackend(max_batch=args.chunk)
+        return PerfRunner(backend=be, batch_size=batch if be else 1,
+                          through_apiserver=boundary, shards=shards,
+                          policy_count=args.policy_set,
+                          audit_rules=[{"level": args.audit_level}]
+                          if args.audit_level else None)
+
+    drain_template = [
+        {"opcode": "createNodes", "countParam": "$nodes"},
+        {"opcode": "createPods", "countParam": "$warmup"},
+        {"opcode": "barrier"},
+        {"opcode": "createPods", "countParam": "$measured",
+         "collectMetrics": True},
+        {"opcode": "barrier"},
+    ]
+    drain = asyncio.run(make_runner().run(
+        drain_template, {"nodes": nodes, "warmup": warmup,
+                         "measured": measured}, timeout=1800.0))
+    serve_template = [
+        {"opcode": "createNodes", "countParam": "$nodes"},
+        {"opcode": "createPods", "countParam": "$warmup"},
+        {"opcode": "barrier"},
+        {"opcode": "churnOpenLoop", "collectMetrics": True,
+         "arrival": {"model": "poisson", "rate": "$rate"},
+         "duration": "$duration", "seed": 17},
+    ]
+    serve = asyncio.run(make_runner().run(
+        serve_template, {"nodes": nodes, "warmup": warmup,
+                         "rate": args.serve_rate,
+                         "duration": args.serve_duration}, timeout=1800.0))
+    d, s = drain.as_dict(), serve.as_dict()
+    print(json.dumps({"serve": s, "drain": d, "preset": args.preset,
+                      "backend": args.backend}), file=sys.stderr)
+    print(json.dumps({
+        "metric": f"serve_single_pod_p50_ms_{args.preset}_{args.backend}"
+                  + (f"_apiserver_{args.transport}" if boundary else ""),
+        "value": s["attempt_p50_ms"],
+        "unit": "ms",
+        "serve_rate": args.serve_rate,
+        "serve_p99_ms": s["attempt_p99_ms"],
+        "serve_p999_ms": s["attempt_p999_ms"],
+        "serve_percentiles_exact": s["attempt_percentiles_exact"],
+        "serve_fast_path_pods": s["serving_fast_path_pods_total"],
+        "drain_pods_per_sec": d["throughput_pods_per_sec"],
+        "drain_vs_baseline": round(
+            d["throughput_pods_per_sec"] / REFERENCE_PODS_PER_SEC, 3),
+    }))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", choices=PRESETS, default="5k")
@@ -152,6 +227,30 @@ def main(argv=None) -> int:
                          "class planes entirely — the per-pod-plane "
                          "before/after sweep knob). Default: flagless "
                          "KTPU_CLASS_PAD (31)")
+    ap.add_argument("--serve", action="store_true",
+                    help="online-serving mode (kubernetes_tpu/serving): "
+                         "report steady-state single-pod placement "
+                         "p50/p99/p999 (exact, open-loop trickle at "
+                         "--serve-rate) ALONGSIDE the preset's unchanged "
+                         "bulk-drain headline in one run")
+    ap.add_argument("--serve-rate", type=float, default=250.0,
+                    help="single-pod arrival rate for --serve (default "
+                         "250/s — the r15 worst-case trickle row)")
+    ap.add_argument("--serve-duration", type=float, default=10.0,
+                    help="seconds of open-loop serve arrivals")
+    ap.add_argument("--admission-window", type=float, default=None,
+                    metavar="MS",
+                    help="OVERRIDE the serving admission coalesce window "
+                         "in milliseconds (0 = always dispatch "
+                         "immediately). Default: flagless — the "
+                         "AdaptiveTuner policy row sizes it from the "
+                         "measured transfer latency and offered-rate "
+                         "estimate (thresholds seeded from the r15 "
+                         "churn knee)")
+    ap.add_argument("--serving", choices=["on", "off"], default="on",
+                    help="KTPU_SERVING kill switch: 'off' degrades the "
+                         "dispatch loop structurally to the pre-serving "
+                         "shape (the before/after sweep knob)")
     ap.add_argument("--churn", action="store_true",
                     help="ChurnDay mode (perf/churn): instead of one "
                          "bulk drain, sweep an OPEN-LOOP Poisson/burst/"
@@ -233,6 +332,12 @@ def main(argv=None) -> int:
         # Must land before the backend module reads it at import.
         import os
         os.environ["KTPU_SHORTLIST_K"] = str(args.shortlist_k)
+    if args.admission_window is not None:
+        import os
+        os.environ["KTPU_ADMISSION_WINDOW"] = str(args.admission_window)
+    if args.serving == "off":
+        import os
+        os.environ["KTPU_SERVING"] = "0"
     if args.class_pad is not None:
         import os
         if args.class_pad <= 0:
@@ -269,9 +374,9 @@ def main(argv=None) -> int:
     if DEFAULT_FEATURE_GATES.enabled("TPUScorer"):
         batch = args.batch_size
         args.backend = "tpu"
-        if not args.churn:
-            # Churn mode builds one fresh backend PER sweep row in its
-            # runner_factory; constructing one here would be dead work.
+        if not args.churn and not args.serve:
+            # Churn/serve modes build fresh backends per phase in their
+            # own factories; constructing one here would be dead work.
             from kubernetes_tpu.ops import TPUBackend
             backend = TPUBackend(max_batch=args.chunk)  # None = adaptive
     else:
@@ -303,6 +408,9 @@ def main(argv=None) -> int:
         boundary = "wire" if args.transport == "wire" else True
     if args.churn:
         return _run_churn(args, nodes, shards, boundary, batch)
+    if args.serve:
+        return _run_serve(args, nodes, warmup, measured, shards, boundary,
+                          batch)
     if not args.through_apiserver and (args.policy_set or args.audit_level):
         # The policy chain lives on the servers: without the boundary
         # these flags measure nothing — refuse to record a lie.

@@ -27,9 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="KTPU wire listener port (0 = ephemeral; "
                          "'off' via --no-wire)")
     ap.add_argument("--no-wire", action="store_true")
-    ap.add_argument("--data-dir", default=None,
+    import os
+    ap.add_argument("--data-dir", default=os.environ.get("KTPU_DATA_DIR"),
                     help="durability directory (WAL + snapshots); "
-                         "recovers state on startup when present")
+                         "recovers state on startup when present "
+                         "(default: $KTPU_DATA_DIR)")
     ap.add_argument("--fsync", choices=["batch", "always"], default="batch")
     ap.add_argument("--token", action="append", default=[],
                     metavar="TOKEN=USER",
@@ -44,21 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def serve(args) -> None:
-    from kubernetes_tpu.store import (
-        DurabilityManager,
-        install_core_validation,
-        new_cluster_store,
-        recover_store,
-    )
-    if args.data_dir:
-        store = recover_store(args.data_dir,
-                              factory=new_cluster_store)
-        mgr = DurabilityManager(store, args.data_dir, fsync=args.fsync)
-        mgr.start()
-    else:
+    from kubernetes_tpu.store import install_core_validation, \
+        new_cluster_store
+    store = None
+    if not args.data_dir:
+        # No durability: plain in-memory store. With --data-dir the
+        # APIServer owns the whole lifecycle (recover on construction,
+        # background flusher/snapshotter, final snapshot on stop).
         store = new_cluster_store()
-        mgr = None
-    install_core_validation(store)
+        install_core_validation(store)
 
     tokens = {}
     for spec in args.token:
@@ -89,7 +85,9 @@ async def serve(args) -> None:
     from kubernetes_tpu.apiserver.wire import WireServer
     api = APIServer(store, host=args.host, port=args.port,
                     bearer_tokens=tokens, authorizer=authorizer,
-                    audit_log=args.audit_log)
+                    audit_log=args.audit_log,
+                    data_dir=args.data_dir, fsync=args.fsync)
+    store = api.store
     await api.start()
     wire = None
     if not args.no_wire:
@@ -109,9 +107,7 @@ async def serve(args) -> None:
     await stop.wait()
     if wire is not None:
         await wire.stop()
-    await api.stop()
-    if mgr is not None:
-        await mgr.stop(final_snapshot=True)
+    await api.stop()  # owns the durability stop + final snapshot
     store.stop()
 
 

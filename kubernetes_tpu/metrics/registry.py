@@ -516,6 +516,31 @@ class SchedulerMetrics:
         self.cross_shard_reductions = r.counter(
             "scheduler_tpu_cross_shard_reductions_total",
             "Top-level cross-shard argmax reductions (pod steps)")
+        #: Serving-tier observability (kubernetes_tpu/serving, ROADMAP
+        #: #3): the admission window's current coalesce hold (0 =
+        #: dispatch-immediately), lone pods placed through the pinned
+        #: C=1 fast path, dispatches whose window merged extra pods,
+        #: and the resident device-plane refresh accounting (count +
+        #: wall of the O(changed) delta requantize/scatter that
+        #: replaces the per-assign full used-state upload).
+        self.admission_window = r.gauge(
+            "scheduler_admission_window_ms",
+            "Serving admission coalesce window applied to the latest "
+            "dispatch (0 = immediate)")
+        self.serving_fast_path_pods = r.counter(
+            "serving_fast_path_pods_total",
+            "Pods placed through the pinned single-pod fast path")
+        self.serving_coalesced_batches = r.counter(
+            "serving_coalesced_batches_total",
+            "Dispatches whose admission window merged extra pods")
+        self.resident_plane_refreshes = r.counter(
+            "resident_plane_refreshes_total",
+            "Refreshes of the device-resident used-state planes "
+            "(incremental scatter or full rebuild)")
+        self.resident_plane_refresh = r.histogram(
+            "resident_plane_refresh_seconds",
+            "Wall time of one resident-plane refresh (delta "
+            "re-quantize + device scatter)")
 
         #: exact windowed percentile recorders riding attempt_duration's
         #: observe path, keyed by (result, profile) — the same population

@@ -234,6 +234,50 @@ class AdaptiveTuner:
     SHORTLIST_FALLBACK_RATIO = 0.25
     #: minimum solved pods before the fallback rate is trusted.
     SHORTLIST_MIN_SAMPLE = 512
+    #: Admission-window policy row (the serving tier, ROADMAP #3 — see
+    #: serving/admission.py for the state machine that consults it).
+    #: Thresholds are seeded from the r15 churn knee sweep (BASELINE
+    #: r15, 5k nodes): the knee sat at 1000/s and the 250/s trickle row
+    #: was the p999 pathology — at or below the idle threshold (set
+    #: just ABOVE the trickle row, so rate-estimate jitter around
+    #: exactly 250/s can't flap it into coalescing) every pod
+    #: dispatches IMMEDIATELY (the fast path is sub-ms; holding a lone
+    #: pod buys nothing), above it the window is sized to coalesce
+    #: ~ADMISSION_TARGET_PODS at the estimated offered rate, capped so
+    #: no pod ever waits past the cap (the cap IS the p50 budget). A
+    #: latency-bound (relay-attached) device quadruples the cap: each
+    #: dispatch costs a size-independent RTT, so fewer, fuller batches
+    #: win exactly as they do for the chunk table above.
+    ADMISSION_IDLE_RATE = 300.0
+    ADMISSION_TARGET_PODS = 8.0
+    ADMISSION_MAX_WINDOW_S = 4e-3
+    #: Fast-path dispatch cap: the largest popped dispatch worth
+    #: draining pod-by-pod through the pinned C=1 solve instead of one
+    #: padded chunk. The crossover is the measured ratio — a chunk's
+    #: wall is fixed (scan over the padded width; ~0.35 s at 5k on the
+    #: CPU container, BASELINE r15/r16) while the fast path pays
+    #: ~1–2 ms per pod, so anything under chunk/fast pods is faster
+    #: serially AND keeps the queue in the lone-pod regime instead of
+    #: locking into batch-every-chunk-wall (the r15 trickle pathology:
+    #: arrivals accumulating during one chunk guarantee the next pop is
+    #: another chunk). Seeds cover the pre-measurement window; the
+    #: serving tier feeds both EWMAs from its own dispatches.
+    FAST_PATH_SEED_CHUNK_S = 0.25
+    #: pre-measurement fast-wall seed: deliberately OPTIMISTIC (1 ms —
+    #: the measured 5k wall is ~0.6 ms) so the seeded rate limit
+    #: (0.5/1 ms = 500/s) clears the 250/s trickle with margin; a
+    #: too-conservative seed suppressed the fast path before any
+    #: sample could land and the suppression was self-sustaining.
+    FAST_PATH_SEED_SOLVE_S = 1e-3
+    FAST_PATH_CAP_MIN = 8
+    FAST_PATH_CAP_MAX = 512
+    #: Serial fast-drain is only right while the OFFERED rate is within
+    #: its capacity (1/fast_wall) with headroom: above this utilization
+    #: the pipelined batch path must take over or the serial drain
+    #: itself becomes the bottleneck — a sustained drain through a
+    #: shared-loop wire self-throttles its own creates to the drain
+    #: rate, so backlog alone never reveals the pressure.
+    FAST_PATH_UTILIZATION = 0.5
 
     def __init__(self):
         self.latency_s: float | None = None
@@ -286,6 +330,36 @@ class AdaptiveTuner:
         """Shortlist hit-rate sample from one finalized chunk."""
         self.solve_pods += pods
         self.solve_fallbacks += fallbacks
+
+    @classmethod
+    def fast_path_cap(cls, chunk_wall_s: float, fast_wall_s: float) -> int:
+        """Largest dispatch the serving tier drains pod-by-pod through
+        the fast path — pure policy over the two measured walls."""
+        if fast_wall_s <= 0:
+            fast_wall_s = cls.FAST_PATH_SEED_SOLVE_S
+        if chunk_wall_s <= 0:
+            chunk_wall_s = cls.FAST_PATH_SEED_CHUNK_S
+        return int(min(max(chunk_wall_s / fast_wall_s,
+                           cls.FAST_PATH_CAP_MIN), cls.FAST_PATH_CAP_MAX))
+
+    @classmethod
+    def fast_path_rate_limit(cls, fast_wall_s: float) -> float:
+        """Highest estimated offered rate (pods/s) the serving tier
+        still serial-drains at — pure policy over the measured wall."""
+        if fast_wall_s <= 0:
+            fast_wall_s = cls.FAST_PATH_SEED_SOLVE_S
+        return cls.FAST_PATH_UTILIZATION / fast_wall_s
+
+    @classmethod
+    def admission_window(cls, latency_s: float, rate_est: float) -> float:
+        """Coalesce window (seconds) for the serving admission tier —
+        pure policy, like pick(). 0.0 = dispatch immediately."""
+        if rate_est <= cls.ADMISSION_IDLE_RATE:
+            return 0.0
+        cap = cls.ADMISSION_MAX_WINDOW_S
+        if latency_s >= cls.LATENCY_BOUND_S:
+            cap = 4.0 * cls.ADMISSION_MAX_WINDOW_S
+        return min(cls.ADMISSION_TARGET_PODS / rate_est, cap)
 
     def shortlist_k(self, chunk: int, n_real: int) -> int:
         """Shortlist width for a chunk, 0 = keep the full N-wide scan."""
@@ -688,6 +762,13 @@ class TPUBackend:
         # chunk's solve so successive chunks dispatch with no host
         # round-trip.
         self._dev_used = None
+        #: serving/resident.ResidentPlanes, attached by the serving tier:
+        #: when present, _start refreshes the used-state pack O(changed)
+        #: from the cache's dirty-set deltas (scatter of re-quantized
+        #: rows) instead of re-uploading the whole (N, 2R+1) array per
+        #: assign() — the device-side twin of r13's incremental host
+        #: prep. None (the KTPU_SERVING=0 shape) keeps the full upload.
+        self.resident = None
         # Vectorized NodeResourceTopologyMatch zone state, cached per
         # (snapshot generation, snapshot identity) — see _nrt_state.
         self._nrt_cache: tuple | None = None
@@ -1506,11 +1587,18 @@ class TPUBackend:
                                 ctx.spread_last_gated = k
                                 break
         ctx.params = self._fwk_params(fwk, ct)
-        # Fresh used-state upload (ONE packed array, ~80 KB) per call;
-        # chunks chain on device from here.
-        self._dev_used = self._put(np.concatenate(
-            [ct.used_q, ct.used_nz_q,
-             ct.used_pods.astype(np.int32)[:, None]], axis=1), "nodes_mat")
+        # Used-state seed for the on-device chunk chain: the serving
+        # tier's resident planes refresh it O(changed) from the cache's
+        # dirty set; without them, one fresh full upload per call.
+        # Either way the chain's post-chunk arrays are NEW device values
+        # — the resident base is never mutated by a batch.
+        if self.resident is not None:
+            self._dev_used = self.resident.used_pack(ct, snapshot)
+        else:
+            self._dev_used = self._put(np.concatenate(
+                [ct.used_q, ct.used_nz_q,
+                 ct.used_pods.astype(np.int32)[:, None]], axis=1),
+                "nodes_mat")
         return ctx
 
     def _fwk_params(self, fwk: Framework, ct: ClusterTensors) -> dict:
@@ -2354,9 +2442,10 @@ class TPUBackend:
                 return self._dispatch_chunk_jit(prep, ctx)
         return self._dispatch_chunk_jit(prep, ctx)
 
-    def _dispatch_chunk_jit(self, prep: dict, ctx: "_AssignCtx") -> dict:
-        ct, p = ctx.ct, ctx.params
-        batch = prep["batch"]
+    def ensure_static(self, ct: ClusterTensors) -> dict:
+        """Device-resident node-static arrays (alloc, taints), refreshed
+        only when the static fingerprint moves — shared by the chunk
+        dispatch and the serving tier's single-pod fast path."""
         if self._dev_static_fp != ct._static_fp or \
                 self._dev_static.get("alloc_shape") != ct.alloc_q.shape:
             self._dev_static = {
@@ -2367,6 +2456,12 @@ class TPUBackend:
                 "alloc_shape": ct.alloc_q.shape,
             }
             self._dev_static_fp = ct._static_fp
+        return self._dev_static
+
+    def _dispatch_chunk_jit(self, prep: dict, ctx: "_AssignCtx") -> dict:
+        ct, p = ctx.ct, ctx.params
+        batch = prep["batch"]
+        self.ensure_static(ct)
 
         sp = ctx.spread
         # The spread scan must run for any chunk whose pods contribute to

@@ -725,6 +725,123 @@ def greedy_assign_rescoring_spread_shortlist(
     return assign, dom_counts2, nfall
 
 
+# ---------------------------------------------------------------------------
+# Pinned single-pod fast path (the serving tier's solve, ROADMAP #3):
+# one C=1 class row against the RESIDENT device planes — gather → mask →
+# score → argmax → debit, no scan, no chunk machinery, no shortlist build.
+# ---------------------------------------------------------------------------
+
+def _solve_one_core(alloc_q, used_pack, alloc_pods, taint_f_mat,
+                    taint_p_mat, mask_bits, host_scores, req_pack,
+                    fit_col_w, bal_col_mask, shape_u, shape_s,
+                    w_fit, w_bal, w_taint, taint_filter_on, strategy):
+    """Traceable body shared by solve_one / solve_one_fresh."""
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = alloc_q.shape[0]
+    r = alloc_q.shape[1]
+    tf = taint_f_mat.shape[1]
+    # Wire decompression, identical to _mask_solve_update's unpack.
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    cmask = ((mask_bits[:, None] >> shifts) & 1).reshape(-1) \
+        .astype(jnp.bool_)[:n]
+    used_q = used_pack[:, :r]
+    used_nz = used_pack[:, r:2 * r]
+    used_pods = used_pack[:, 2 * r]
+    req_q = req_pack[None, :r]
+    req_nz = req_pack[None, r:2 * r]
+    untol_f = req_pack[2 * r:2 * r + tf].astype(jnp.bool_)[None]
+    untol_p = req_pack[2 * r + tf:].astype(jnp.bool_)[None]
+
+    fit0 = kernels.fit_filter_mask(
+        alloc_q, used_q, used_pods, alloc_pods, req_q)          # (1,N)
+    taint_ok = kernels.taint_filter_mask(taint_f_mat, untol_f)
+    taint_ok = taint_ok | jnp.logical_not(taint_filter_on)
+    mask = cmask[None, :] & taint_ok
+    feasible = mask & fit0
+    static = host_scores[None, :].astype(jnp.float32) \
+        + w_taint * kernels.taint_toleration_score(
+            taint_p_mat, untol_p, feasible)
+
+    # The scan step body for pod 0: chunk-start free state IS the
+    # current state for a single-pod "chunk".
+    free_q = alloc_q - used_q
+    free_pods = alloc_pods - used_pods
+    fits = mask[0] & jnp.all(req_q[0][None, :] <= free_q, axis=1) \
+        & (free_pods >= 1)
+    sc = static[0]
+    sc = sc + w_fit * kernels.fit_score(
+        alloc_q, used_nz, req_nz, fit_col_w, strategy, shape_u, shape_s)[0]
+    sc = sc + w_bal * kernels.balanced_allocation_score(
+        alloc_q, used_nz, req_nz, bal_col_mask)[0]
+    masked = jnp.where(fits, sc, NEG_INF)
+    idx = jnp.argmax(masked).astype(jnp.int32)
+    return jnp.where(jnp.any(fits), idx, jnp.int32(-1))
+
+
+@partial(jax.jit, static_argnames=("strategy",))
+def solve_one(alloc_q, used_pack, alloc_pods, taint_f_mat, taint_p_mat,
+              mask_bits, host_scores, req_pack,
+              fit_col_w, bal_col_mask, shape_u, shape_s,
+              w_fit, w_bal, w_taint, taint_filter_on, strategy: str):
+    """One pod against the resident cluster planes, bit-identical to the
+    batch path's first scan step.
+
+    This is deliberately the EXACT composition `_mask_solve_update` +
+    `greedy_assign_rescoring` compute for the first pod of a chunk — the
+    same kernels in the same order on the same dtypes — so a lone pod
+    routed here by the serving tier's admission window gets the
+    assignment the batch path would have given it (the smoke suite's
+    randomized differential pins it). What is REMOVED is everything a
+    lone pod cannot use: the P-step scan, multistart permutation set,
+    shortlist prefilter/top-k, gang masks, spread carry, per-chunk plane
+    build. The program is fixed-shape per (N, R, T) cluster signature,
+    so after the first compile a placement is one dispatch.
+
+    mask_bits: (N/8,) uint8 bit-packed host filter row (the pod's AND-
+        folded static rows; all-true for the common template pod).
+    host_scores: (N,) f16/f32 host score row (zero for the common pod —
+        cast to f32 on device exactly like the batch wire).
+    req_pack: (2R+tf+tp,) int32 — req_q ‖ req_nz_q ‖ untol_f ‖ untol_p,
+        the class_pack row of this pod's equivalence class.
+    used_pack: (N, 2R+1) int32 resident used-state (used_q ‖ used_nz_q ‖
+        used_pods) — the serving tier keeps it warm on device and
+        refreshes O(changed) rows from the cache's dirty set.
+
+    Returns the node index as an int32 scalar (-1 = no fit). There is
+    deliberately NO debit output: the placement's assume re-enters
+    through the cache's dirty set and the next refresh re-quantizes
+    that one row — a debited pack here would be dead work per solve
+    (and double-count against the refresh).
+    """
+    return _solve_one_core(
+        alloc_q, used_pack, alloc_pods, taint_f_mat, taint_p_mat,
+        mask_bits, host_scores, req_pack, fit_col_w, bal_col_mask,
+        shape_u, shape_s, w_fit, w_bal, w_taint, taint_filter_on, strategy)
+
+
+@partial(jax.jit, static_argnames=("strategy",))
+def solve_one_fresh(alloc_q, used_pack, rows, vals, alloc_pods,
+                    taint_f_mat, taint_p_mat, mask_bits, host_scores,
+                    req_pack, fit_col_w, bal_col_mask, shape_u, shape_s,
+                    w_fit, w_bal, w_taint, taint_filter_on, strategy: str):
+    """solve_one with the resident-plane refresh FUSED in: scatter the
+    dirty rows (`vals` re-quantized host-side, rows bucket-padded by
+    repeating the first index — idempotent) into the resident pack,
+    then solve against the refreshed state — ONE device dispatch where
+    refresh-then-solve was two, which is most of the fast path's wall
+    on a local device. Returns (idx, refreshed_pack): the caller keeps
+    the refreshed (PRE-debit) pack as the new resident base — the
+    solve's own assume re-enters through the cache's dirty set, so
+    debiting here would double-count it on the next refresh."""
+    pack = used_pack.at[rows].set(vals)
+    idx = _solve_one_core(
+        alloc_q, pack, alloc_pods, taint_f_mat, taint_p_mat,
+        mask_bits, host_scores, req_pack, fit_col_w, bal_col_mask,
+        shape_u, shape_s, w_fit, w_bal, w_taint, taint_filter_on, strategy)
+    return idx, pack
+
+
 #: int32 "no victim" priority padding — mirrors _WaveState.INF (int64 there;
 #: the device scan runs int32, and k8s priorities are int32 by API).
 PRIO_INF = jnp.int32(2**31 - 1)

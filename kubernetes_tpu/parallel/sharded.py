@@ -341,6 +341,46 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
 
 
 # ---------------------------------------------------------------------------
+# resident-plane row scatter (the serving tier's device-side delta)
+# ---------------------------------------------------------------------------
+
+_SCATTER_CACHE: dict = {}
+
+
+def resident_row_scatter(mesh: Mesh | None, sharding=None):
+    """Jitted `pack.at[rows].set(vals)` for the serving tier's resident
+    used-state planes (serving/resident.py): the device-side twin of the
+    r13 per-shard delta requantization. Rows/vals are tiny (the cache's
+    dirty set — O(assumed pods) per cycle), so under a mesh they ride
+    replicated while the (N, 2R+1) pack stays sharded over the nodes
+    axis: `out_shardings` pins the result's sharding so the resident
+    array never silently de-shards across refreshes (a gathered pack
+    would re-pay the full-upload cost the scatter exists to avoid). On
+    a single device (mesh=None) it is a plain jitted scatter.
+
+    Cached per (mesh, sharding) like the solver bodies; jax versions
+    without jit out_shardings fall back to propagation (correct, at
+    worst one re-shard on the next dispatch)."""
+    key = (mesh, sharding)
+    fn = _SCATTER_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def body(pack, rows, vals):
+        return pack.at[rows].set(vals)
+
+    if mesh is not None and sharding is not None:
+        try:
+            fn = jax.jit(body, out_shardings=sharding)
+        except TypeError:  # pragma: no cover - older jax kwarg names
+            fn = jax.jit(body)
+    else:
+        fn = jax.jit(body)
+    _SCATTER_CACHE[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
 # phase 2b: multi-slice solver (2-D slice × nodes mesh — config #5)
 # ---------------------------------------------------------------------------
 

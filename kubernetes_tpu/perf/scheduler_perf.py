@@ -158,6 +158,18 @@ class WorkloadResult:
         self.shard_tensor_rebuilds_total = 0
         self.shard_solve_seconds = 0.0
         self.cross_shard_reductions_total = 0
+        #: Serving-tier accounting over the measured phase
+        #: (kubernetes_tpu/serving, ROADMAP #3): lone pods placed
+        #: through the pinned C=1 fast path, dispatches whose admission
+        #: window merged extra pods, resident device-plane refreshes
+        #: (count + wall of the O(changed) scatter), and the admission
+        #: window the tier last applied. Zeros under KTPU_SERVING=0 —
+        #: the structural-degrade witness.
+        self.serving_fast_path_pods_total = 0
+        self.serving_coalesced_batches_total = 0
+        self.resident_plane_refreshes_total = 0
+        self.resident_plane_refresh_seconds_total = 0.0
+        self.admission_window_ms = 0.0
         #: startAgents opcode wall (the cold-start fleet boot measured
         #: by the agent-batching satellite; 0.0 when no agents started).
         self.agent_start_seconds = 0.0
@@ -243,6 +255,14 @@ class WorkloadResult:
             "shard_tensor_rebuilds_total": self.shard_tensor_rebuilds_total,
             "shard_solve_seconds": round(self.shard_solve_seconds, 3),
             "cross_shard_reductions_total": self.cross_shard_reductions_total,
+            "serving_fast_path_pods_total": self.serving_fast_path_pods_total,
+            "serving_coalesced_batches_total":
+                self.serving_coalesced_batches_total,
+            "resident_plane_refreshes_total":
+                self.resident_plane_refreshes_total,
+            "resident_plane_refresh_seconds_total": round(
+                self.resident_plane_refresh_seconds_total, 4),
+            "admission_window_ms": self.admission_window_ms,
             "agent_start_seconds": round(self.agent_start_seconds, 3),
             "churn_offered_rate": round(self.churn_offered_rate, 2),
             "churn_achieved_rate": round(self.churn_achieved_rate, 2),
@@ -925,6 +945,10 @@ class PerfRunner:
             sum(metrics.shard_tensor_rebuilds._values.values()),
             sum(metrics.shard_solve_seconds._values.values()),
             metrics.cross_shard_reductions.value(),
+            metrics.serving_fast_path_pods.value(),
+            metrics.serving_coalesced_batches.value(),
+            metrics.resident_plane_refreshes.value(),
+            metrics.resident_plane_refresh.sum(),
             metrics.attempt_window().mark())
 
     def _end_measure(self, result: WorkloadResult,
@@ -936,6 +960,7 @@ class PerfRunner:
          solve_chunks_base, solve_s_base, sl_pods_base,
          sl_fall_base, prep_s_base, plane_b_base, class_fb_base,
          shard_rb_base, shard_s_base, xshard_base,
+         fast_base, coalesced_base, refresh_base, refresh_s_base,
          window_mark) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
@@ -1001,6 +1026,15 @@ class PerfRunner:
             sum(metrics.shard_solve_seconds._values.values()) - shard_s_base
         result.cross_shard_reductions_total = int(
             metrics.cross_shard_reductions.value() - xshard_base)
+        result.serving_fast_path_pods_total = int(
+            metrics.serving_fast_path_pods.value() - fast_base)
+        result.serving_coalesced_batches_total = int(
+            metrics.serving_coalesced_batches.value() - coalesced_base)
+        result.resident_plane_refreshes_total = int(
+            metrics.resident_plane_refreshes.value() - refresh_base)
+        result.resident_plane_refresh_seconds_total = \
+            metrics.resident_plane_refresh.sum() - refresh_s_base
+        result.admission_window_ms = metrics.admission_window.value()
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

@@ -230,6 +230,37 @@ class SchedulerCache:
             # scheduler re-snapshots ~10× per cycle and the no-op calls
             # must not pay two O(N) copies each at 200k nodes.
             return self._last_snap
+        self._refresh_clones()
+        snap = self._make_snapshot(self._snap_list.copy(),
+                                   dict(self._snap_nodes))
+        self._last_snap = snap
+        return snap
+
+    def light_snapshot(self) -> Snapshot:
+        """ZERO-COPY snapshot for the serving fast path: same clone
+        maintenance as update_snapshot, but the returned Snapshot WRAPS
+        the cache's live list/dict instead of copying them — the two
+        O(N) copies were most of the fast path's host wall at 5k nodes,
+        paid per lone-pod placement for a one-row change.
+
+        Contract: consume SYNCHRONOUSLY and drop before the next cache
+        mutation — any assume/informer event replaces entries beneath
+        it (update_snapshot's copies exist precisely for callers that
+        hold snapshots across mutations, like the batch pipeline's
+        chunked verify). Never cached as _last_snap for the same
+        reason."""
+        if self._full or self._dirty:
+            # This maintenance clears the dirty set, but _last_snap's
+            # COPIED lists still hold the pre-mutation clones — without
+            # this invalidation the next update_snapshot()'s clean-path
+            # guard would hand that stale snapshot back.
+            self._last_snap = None
+        self._refresh_clones()
+        return self._make_snapshot(self._snap_list, self._snap_nodes)
+
+    def _refresh_clones(self) -> None:
+        """Shared maintenance: re-clone dirty/removed nodes into the
+        stable snapshot list (see update_snapshot)."""
         if self._full:
             self._snap_nodes = {}
             self._snap_list = []
@@ -257,11 +288,13 @@ class SchedulerCache:
             if len(self._changed_log) > 4 * len(self._snap_list) + 65536:
                 self._changed_log = []
                 self._log_floor = self._generation
+
+    def _make_snapshot(self, nodes: list, by_name: dict) -> Snapshot:
         # Affinity lists in snapshot-position order (deterministic — the
         # unsharded and sharded paths must build identical tables).
         pos = self._snap_pos.get
-        snap = Snapshot(self._snap_list.copy(), self._generation,
-                        by_name=dict(self._snap_nodes),
+        snap = Snapshot(nodes, self._generation,
+                        by_name=by_name,
                         have_affinity=[self._snap_nodes[n] for n in
                                        sorted(self._aff_names, key=pos)],
                         have_anti_affinity=[self._snap_nodes[n] for n in
@@ -288,7 +321,6 @@ class SchedulerCache:
             return out
 
         snap.changed_since = changed_since
-        self._last_snap = snap
         return snap
 
     def pod_count(self) -> int:

@@ -176,18 +176,33 @@ class SchedulingQueue:
                     continue
             if self._closed and not self._active:
                 return []
-            out: list[PodInfo] = []
-            now = self.clock()
-            while self._active and len(out) < max_pods:
-                _, _, pi = heapq.heappop(self._active)
-                self._active_keys.discard(pi.key)
-                pi.attempts += 1
-                # Queue-wait endpoint for the attempt's retroactive
-                # scheduler.queue.wait span (queued_at → dequeued_at).
-                pi.dequeued_at = now
-                self._in_flight.add(pi.key)
-                out.append(pi)
-            return out
+            return self._drain_locked(max_pods)
+
+    def _drain_locked(self, max_pods: int) -> list[PodInfo]:
+        out: list[PodInfo] = []
+        now = self.clock()
+        while self._active and len(out) < max_pods:
+            _, _, pi = heapq.heappop(self._active)
+            self._active_keys.discard(pi.key)
+            pi.attempts += 1
+            # Queue-wait endpoint for the attempt's retroactive
+            # scheduler.queue.wait span (queued_at → dequeued_at).
+            pi.dequeued_at = now
+            self._in_flight.add(pi.key)
+            out.append(pi)
+        return out
+
+    async def pop_now(self, max_pods: int) -> list[PodInfo]:
+        """NON-blocking drain: whatever is ready right now (due backoff
+        flushed first), possibly empty — the serving tier's admission
+        window merges this into a held dispatch after its coalesce
+        sleep, where a blocking pop would stall the batch it already
+        holds."""
+        async with self._cond:
+            self._flush_backoff_locked()
+            if self._closed:
+                return []
+            return self._drain_locked(max_pods)
 
     def _flush_backoff_locked(self) -> None:
         now = self.clock()

@@ -141,6 +141,12 @@ class Scheduler:
         #: None = all profiles (constructor-injected backend, old behavior).
         self.backend_profiles: set[str] | None = None
         self.extenders: list = []
+        #: serving.ServingTier (admission window + resident planes +
+        #: single-pod fast path), attached lazily at run()-loop entry by
+        #: serving.maybe_attach_serving — flagless when a batched
+        #: backend is present; KTPU_SERVING=0 keeps it None and the
+        #: loop structurally identical to the pre-serving shape.
+        self.serving = None
         self.recorder = EventRecorder(store, "default-scheduler")
         self._informer_factory: InformerFactory | None = None
         self._binding_tasks: set[asyncio.Task] = set()
@@ -1005,12 +1011,22 @@ class Scheduler:
             return
 
     async def run(self, batch_size: int = 1) -> None:
-        """wait.UntilWithContext(sched.ScheduleOne) — plus flushers."""
+        """wait.UntilWithContext(sched.ScheduleOne) — plus flushers.
+
+        With a batched backend attached the loop runs through the
+        serving tier (admission window + single-pod fast path —
+        kubernetes_tpu/serving); KTPU_SERVING=0 degrades structurally
+        to the plain schedule_batch loop below."""
         flusher = asyncio.ensure_future(self.queue.run_flushers())
         janitor = asyncio.ensure_future(self._cache_janitor())
+        from kubernetes_tpu.serving import maybe_attach_serving
+        serving = maybe_attach_serving(self)
         try:
             while not self._stop:
-                more = await self.schedule_batch(batch_size)
+                if serving is not None:
+                    more = await serving.schedule_next(batch_size)
+                else:
+                    more = await self.schedule_batch(batch_size)
                 if not more:
                     break
                 self.metrics.set_pending(self.queue.stats())

@@ -234,7 +234,12 @@ class TestTPUScorerGate:
         assert sched.backend_profiles == {"default-scheduler"}
         store.stop()
 
-    def test_gate_on_schedules_through_backend_e2e(self):
+    def test_gate_on_schedules_through_backend_e2e(self, monkeypatch):
+        # This test probes the gate's BATCH wiring (assign_stream); the
+        # serving tier would legitimately fast-drain a 12-pod workload
+        # through the pinned single-pod solve instead — pin it off.
+        monkeypatch.setenv("KTPU_SERVING", "0")
+
         async def body():
             store = new_cluster_store()
             install_core_validation(store)

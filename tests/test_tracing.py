@@ -299,7 +299,14 @@ class TestPerfettoExport:
     """(c) schema-valid Chrome trace JSON with device-solve chunks nested
     under the scheduling attempt."""
 
-    def test_solve_spans_nest_under_attempt(self, tracer):
+    def test_solve_spans_nest_under_attempt(self, tracer, monkeypatch):
+        # This test pins the CHUNKED solve's span nesting
+        # (solver.dispatch/solve under the batch attempt); the serving
+        # tier would legitimately fast-drain a 4-pod batch through the
+        # pinned single-pod solve (which has no chunk spans) — pin it
+        # off for the chunk-path assertion.
+        monkeypatch.setenv("KTPU_SERVING", "0")
+
         async def body():
             from kubernetes_tpu.client import InformerFactory
             from kubernetes_tpu.ops import TPUBackend
