@@ -326,10 +326,23 @@ def main(argv=None) -> int:
                     help='e.g. "TPUScorer=true" — the north-star seam: the '
                          "batched device backend hangs off this gate "
                          "(--backend tpu is sugar for enabling it)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the repo's static analysis "
+                         "(python -m kubernetes_tpu.analysis) and print a "
+                         "finding summary; exit 0 clean / 1 findings / 2 "
+                         "internal error")
+    ap.add_argument("--lint-json", action="store_true",
+                    help="--lint with machine-readable JSON on stdout")
     args = ap.parse_args(argv)
 
+    if args.lint or args.lint_json:
+        from kubernetes_tpu.analysis import main as lint_main
+        return lint_main(["--json"] if args.lint_json else [])
+
     if args.shortlist_k is not None:
-        # Must land before the backend module reads it at import.
+        # Flag reads are live (utils/flags.py), so ordering vs the
+        # backend import no longer matters — the old import-time read
+        # was the flag lint's first catch.
         import os
         os.environ["KTPU_SHORTLIST_K"] = str(args.shortlist_k)
     if args.admission_window is not None:

@@ -1,0 +1,8 @@
+"""`python -m kubernetes_tpu.analysis` — the ktpu-lint CLI."""
+
+import sys
+
+from kubernetes_tpu.analysis import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,8 +27,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="KTPU wire listener port (0 = ephemeral; "
                          "'off' via --no-wire)")
     ap.add_argument("--no-wire", action="store_true")
-    import os
-    ap.add_argument("--data-dir", default=os.environ.get("KTPU_DATA_DIR"),
+    from kubernetes_tpu.utils import flags
+    ap.add_argument("--data-dir", default=flags.get("KTPU_DATA_DIR"),
                     help="durability directory (WAL + snapshots); "
                          "recovers state on startup when present "
                          "(default: $KTPU_DATA_DIR)")

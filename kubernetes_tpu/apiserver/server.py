@@ -228,8 +228,8 @@ class APIServer:
         #: that store without recovery (the caller owns its contents).
         self.durability = None
         if store is None:
-            import os as _os
-            data_dir = data_dir or _os.environ.get("KTPU_DATA_DIR")
+            from kubernetes_tpu.utils import flags
+            data_dir = data_dir or flags.get("KTPU_DATA_DIR")
             if not data_dir:
                 raise ValueError(
                     "APIServer needs a store, a data_dir, or KTPU_DATA_DIR")

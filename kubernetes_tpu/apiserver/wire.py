@@ -56,6 +56,8 @@ from typing import AsyncIterator, Callable, Mapping
 
 import msgpack
 
+from kubernetes_tpu.utils.locking import check_dispatch_seam
+
 from kubernetes_tpu.api.labels import (
     Selector,
     parse_selector,
@@ -261,6 +263,10 @@ class _Conn(asyncio.Protocol):
     def _flush(self) -> None:
         self._flush_scheduled = False
         if self._out and not self._closed:
+            # Sanctioned wire-send seam: the lock-hygiene detector
+            # (KTPU_LOCK_CHECK=1) raises here if the flushing thread
+            # still holds an instrumented lock.
+            check_dispatch_seam("wire.flush")
             self.transport.write(b"".join(self._out))
             self._out.clear()
 

@@ -13,9 +13,10 @@ from __future__ import annotations
 import bisect
 import itertools
 import math
-import threading
 from collections import defaultdict
 from typing import Iterable, Mapping
+
+from kubernetes_tpu.utils.locking import new_lock
 
 
 def _esc_label(value) -> str:
@@ -36,7 +37,7 @@ class Counter:
         self.help = help_
         self.label_names = tuple(labels)
         self._values: dict[tuple, float] = defaultdict(float)
-        self._lock = threading.Lock()
+        self._lock = new_lock(f"metrics.{name}")
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = tuple(labels.get(n, "") for n in self.label_names)
@@ -60,7 +61,14 @@ class Counter:
         # text itself contained the word "counter".
         lines = [f"# HELP {self.name} {_esc_help(self.help)}",
                  f"# TYPE {self.name} {type_}"]
-        for key, v in sorted(self._values.items()):
+        # Snapshot under the lock: inc() runs in worker threads (the
+        # backend's to_thread solve fetch observes metrics), and
+        # iterating the live dict while one lands a NEW label key raises
+        # "dictionary changed size during iteration" — the lock-hygiene
+        # pass (LK205) caught this unlocked iteration.
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             lbl = ",".join(f'{n}="{_esc_label(val)}"'
                            for n, val in zip(self.label_names, key))
             lines.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
@@ -99,7 +107,7 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._totals: dict[tuple, int] = defaultdict(int)
-        self._lock = threading.Lock()
+        self._lock = new_lock(f"metrics.{name}")
 
     def observe(self, value: float, **labels: str) -> None:
         # Single-bucket increment (bisect); cumulative "le" semantics are
@@ -124,9 +132,13 @@ class Histogram:
 
     def snapshot(self, **labels: str) -> tuple[list[int], int]:
         """(cumulative bucket counts, total) at this instant — pair with
-        percentile_since for windowed percentiles (bench measured phase)."""
+        percentile_since for windowed percentiles (bench measured phase).
+        Read under the lock: observe() runs in worker threads (the solve
+        fetch), and a half-updated (counts, total) pair would misreport
+        the window (the LK205 unlocked-read family)."""
         key = tuple(labels.get(n, "") for n in self.label_names)
-        return self._cumulative(key), self._totals.get(key, 0)
+        with self._lock:
+            return self._cumulative(key), self._totals.get(key, 0)
 
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket counts (for reports/bench)."""
@@ -142,10 +154,11 @@ class Histogram:
         answer directly."""
         key = tuple(labels.get(n, "") for n in self.label_names)
         base_counts, base_total = base
-        total = self._totals.get(key, 0) - base_total
-        if key not in self._counts or total <= 0:
-            return math.nan
-        counts = self._cumulative(key)
+        with self._lock:
+            total = self._totals.get(key, 0) - base_total
+            if key not in self._counts or total <= 0:
+                return math.nan
+            counts = self._cumulative(key)
         rank = q * total
         for i, (c, b) in enumerate(zip(counts, base_counts)):
             if c - b >= rank:
@@ -163,17 +176,22 @@ class Histogram:
     def render(self) -> str:
         lines = [f"# HELP {self.name} {_esc_help(self.help)}",
                  f"# TYPE {self.name} histogram"]
-        for key in sorted(self._totals):
+        # Consistent snapshot under the lock (see Counter._render): a
+        # worker-thread observe() landing a new key mid-iteration raised,
+        # and a sum/count torn across an observe misstates the series.
+        with self._lock:
+            series = [(key, self._cumulative(key), self._totals[key],
+                       self._sums[key]) for key in sorted(self._totals)]
+        for key, counts, total, sum_ in series:
             base = ",".join(f'{n}="{_esc_label(v)}"'
                             for n, v in zip(self.label_names, key))
-            counts = self._cumulative(key)
             for b, c in zip(self.buckets, counts):
                 sep = "," if base else ""
                 lines.append(f'{self.name}_bucket{{{base}{sep}le="{b}"}} {c}')
             sep = "," if base else ""
-            lines.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {self._totals[key]}')
-            lines.append(f"{self.name}_sum{{{base}}} {self._sums[key]}")
-            lines.append(f"{self.name}_count{{{base}}} {self._totals[key]}")
+            lines.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {total}')
+            lines.append(f"{self.name}_sum{{{base}}} {sum_}")
+            lines.append(f"{self.name}_count{{{base}}} {total}")
         return "\n".join(lines)
 
 
@@ -524,7 +542,7 @@ class SchedulerMetrics:
         #: wall of the O(changed) delta requantize/scatter that
         #: replaces the per-assign full used-state upload).
         self.admission_window = r.gauge(
-            "scheduler_admission_window_ms",
+            "scheduler_admission_window_seconds",
             "Serving admission coalesce window applied to the latest "
             "dispatch (0 = immediate)")
         self.serving_fast_path_pods = r.counter(

@@ -55,7 +55,6 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import time
 from functools import partial
 from typing import Mapping, Sequence
@@ -65,6 +64,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.labels import ns_contains
+from kubernetes_tpu.utils import flags
+from kubernetes_tpu.utils.locking import check_dispatch_seam
 from kubernetes_tpu.ops import kernels, solver
 from kubernetes_tpu.ops.tensorize import ClusterTensors, PodBatch
 from kubernetes_tpu.scheduler.framework import (
@@ -97,20 +98,26 @@ DEVICE_SCORE_PLUGINS = {
 
 #: Pipeline-depth OVERRIDE (sweeps/debugging). Unset = the AdaptiveTuner
 #: picks the depth from the measured transfer latency; see its policy
-#: docstring and the BASELINE.md r6 depth sweep.
-_PIPELINE_DEPTH_OVERRIDE = int(os.environ["KTPU_PIPELINE_DEPTH"]) \
-    if os.environ.get("KTPU_PIPELINE_DEPTH") else None
+#: docstring and the BASELINE.md r6 depth sweep. Read LIVE per use —
+#: the old import-time read forced callers (bench.py) to export the
+#: env var before this module imported, an ordering footgun the flag
+#: lint (analysis/flags_pass.py) now rejects.
+def _pipeline_depth_override() -> int | None:
+    return flags.get("KTPU_PIPELINE_DEPTH")
+
 
 #: Solve chunk before the tuner has decided (also the latency-bound dirty
 #: pick, so a wrong warmup guess is never catastrophic).
 _DEFAULT_CHUNK = 1024
 
-#: Shortlist OVERRIDE (sweeps/differential tests): an integer K forces the
-#: shortlist width regardless of the tuner's policy, 0 disables pruning
-#: entirely. Unset = flagless — the AdaptiveTuner derives K from the chunk
-#: width and the observed fallback rate (see its shortlist_k policy).
-_SHORTLIST_K_OVERRIDE = int(os.environ["KTPU_SHORTLIST_K"]) \
-    if os.environ.get("KTPU_SHORTLIST_K") else None
+
+def _shortlist_k_override() -> int | None:
+    """Shortlist OVERRIDE (sweeps/differential tests): an integer K forces
+    the shortlist width regardless of the tuner's policy, 0 disables
+    pruning entirely. Unset = flagless — the AdaptiveTuner derives K from
+    the chunk width and the observed fallback rate (see its shortlist_k
+    policy). Live read, like the pipeline depth."""
+    return flags.get("KTPU_SHORTLIST_K")
 
 #: Class-dictionary plane cap: the maximum number of REAL pod
 #: equivalence classes per chunk (plane row 0 is reserved for the empty
@@ -132,13 +139,9 @@ DEFAULT_CLASS_PAD = 31
 def class_pad() -> int:
     """Effective class cap: 0 = class planes off (per-pod fallback).
     Read per assign() so tests/bench can flip the env knobs live."""
-    if os.environ.get("KTPU_CLASS_PLANES", "1") in ("0", "false", "False"):
+    if not flags.get("KTPU_CLASS_PLANES"):
         return 0
-    try:
-        return max(0, int(os.environ.get("KTPU_CLASS_PAD",
-                                         str(DEFAULT_CLASS_PAD))))
-    except ValueError:
-        return DEFAULT_CLASS_PAD
+    return max(0, flags.get("KTPU_CLASS_PAD"))
 
 
 def _class_rows_bucket(n_classes: int) -> int:
@@ -363,8 +366,9 @@ class AdaptiveTuner:
 
     def shortlist_k(self, chunk: int, n_real: int) -> int:
         """Shortlist width for a chunk, 0 = keep the full N-wide scan."""
-        if _SHORTLIST_K_OVERRIDE is not None:
-            k = _SHORTLIST_K_OVERRIDE
+        override = _shortlist_k_override()
+        if override is not None:
+            k = override
             return k if 0 < k < n_real else 0
         k = chunk * self.shortlist_boost
         if n_real < self.SHORTLIST_FACTOR * (k + chunk):
@@ -686,8 +690,9 @@ class TPUBackend:
         self.max_batch = max_batch if max_batch is not None \
             else _DEFAULT_CHUNK
         self._tuner = AdaptiveTuner()
-        self.pipeline_depth = _PIPELINE_DEPTH_OVERRIDE \
-            if _PIPELINE_DEPTH_OVERRIDE is not None else 4
+        depth_override = _pipeline_depth_override()
+        self.pipeline_depth = depth_override \
+            if depth_override is not None else 4
         #: parallel permuted-order scans per chunk (1 = oracle-only order).
         #: Selection: most pods placed, then most request volume placed,
         #: identity on full ties — never fewer pods than the oracle order,
@@ -1472,6 +1477,7 @@ class TPUBackend:
         for that blind spot: scheduler_tpu_solve_seconds per chunk, plus
         the solver scan width / shortlist fallback counters extracted
         from the same fetch in _finalize_chunk."""
+        check_dispatch_seam("backend.fetch_assign")
         tr = self.tracer
         span = tr.span("solver.solve", chunk=run.get("chunk_idx"),
                        pods=run["batch"].p_real) \
@@ -1522,7 +1528,7 @@ class TPUBackend:
                             self._tuner.dirty_chunks
                             / max(1, self._tuner.total_chunks))
                 self.max_batch = chunk
-            if _PIPELINE_DEPTH_OVERRIDE is None:
+            if _pipeline_depth_override() is None:
                 self.pipeline_depth = depth
         ct = self._tensors(snapshot)
         pods = list(pods)
@@ -2606,6 +2612,7 @@ class TPUBackend:
     def _fetch_diag_planes(run: dict) -> None:
         """Worker-thread fetch of the diagnostic unsat planes: start both
         device→host copies before blocking so the relay trips overlap."""
+        check_dispatch_seam("backend.fetch_diag_planes")
         for k in ("fit0_d", "taint_ok_d"):
             a = run.get(k)
             if a is not None and hasattr(a, "copy_to_host_async"):

@@ -347,22 +347,15 @@ class PerfRunner:
 
     async def run(self, template_ops: list, params: Mapping[str, Any],
                   timeout: float = 600.0) -> WorkloadResult:
-        import os
+        from kubernetes_tpu.utils import flags
         if self.shards is None:
             return await self._run_inner(template_ops, params, timeout)
         # The host prep's per-shard accounting resolves the same
         # flagless policy (control_plane_shards); an explicit shard
-        # request must reach it too — scoped to this run (local save so
-        # overlapping runs can't cross-restore each other's value).
-        prev = os.environ.get("KTPU_SHARDS")
-        os.environ["KTPU_SHARDS"] = str(self.shards)
-        try:
+        # request must reach it too — scoped to this run (save/restore
+        # so overlapping runs can't cross-restore each other's value).
+        with flags.scoped_set("KTPU_SHARDS", self.shards):
             return await self._run_inner(template_ops, params, timeout)
-        finally:
-            if prev is None:
-                os.environ.pop("KTPU_SHARDS", None)
-            else:
-                os.environ["KTPU_SHARDS"] = prev
 
     async def _run_inner(self, template_ops: list,
                          params: Mapping[str, Any],
@@ -1034,7 +1027,9 @@ class PerfRunner:
             metrics.resident_plane_refreshes.value() - refresh_base)
         result.resident_plane_refresh_seconds_total = \
             metrics.resident_plane_refresh.sum() - refresh_s_base
-        result.admission_window_ms = metrics.admission_window.value()
+        # Gauge is base-unit seconds now (metrics lint); the detail JSON
+        # field keeps its ms name for report continuity.
+        result.admission_window_ms = 1e3 * metrics.admission_window.value()
 
     async def _wait_bound(self, bound_keys: set, want: int,
                           deadline: float) -> None:

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import random
 import time
 from typing import Mapping
@@ -32,6 +31,7 @@ from kubernetes_tpu.api.types import pod_is_terminal
 from kubernetes_tpu.client import EventRecorder, InformerFactory, ResourceEventHandler
 from kubernetes_tpu.metrics.registry import SchedulerMetrics
 from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.utils import flags
 from kubernetes_tpu.scheduler.framework import (
     CycleState,
     Framework,
@@ -130,8 +130,8 @@ class Scheduler:
         #: KTPU_TRACE_THRESHOLD_MS (the tracer's tree-dump threshold
         #: reads the same variable), else the reference's 100ms.
         if trace_threshold_ms is None:
-            trace_threshold_ms = float(
-                os.environ.get("KTPU_TRACE_THRESHOLD_MS") or 100.0)
+            env = flags.get("KTPU_TRACE_THRESHOLD_MS")
+            trace_threshold_ms = env if env is not None else 100.0
         self.trace_threshold_ms = trace_threshold_ms
         self.rng = random.Random(seed)
         self.backend = None  # TPU batch backend; None = host path

@@ -35,12 +35,11 @@ used-state uploads, lone pods on the host path).
 
 from __future__ import annotations
 
-import os
-
 from kubernetes_tpu.serving.admission import AdmissionWindow
 from kubernetes_tpu.serving.fastpath import SinglePodFastPath
 from kubernetes_tpu.serving.loop import ServingTier
 from kubernetes_tpu.serving.resident import ResidentPlanes
+from kubernetes_tpu.utils import flags
 
 __all__ = [
     "AdmissionWindow",
@@ -55,7 +54,7 @@ __all__ = [
 def serving_enabled() -> bool:
     """KTPU_SERVING kill switch; default ON (the serving tier is the
     flagless production shape, like the class planes and the shortlist)."""
-    return os.environ.get("KTPU_SERVING", "1") not in ("0", "false", "False")
+    return flags.get("KTPU_SERVING")
 
 
 def maybe_attach_serving(sched) -> "ServingTier | None":

@@ -35,20 +35,14 @@ immediately — the admission half of the KTPU_SERVING=0 degrade).
 
 from __future__ import annotations
 
-import os
 import time
 
 from kubernetes_tpu.ops.backend import AdaptiveTuner
+from kubernetes_tpu.utils import flags
 
 
 def _window_override_ms() -> float | None:
-    v = os.environ.get("KTPU_ADMISSION_WINDOW")
-    if v is None or v == "":
-        return None
-    try:
-        return max(0.0, float(v))
-    except ValueError:
-        return None
+    return flags.get("KTPU_ADMISSION_WINDOW")
 
 
 class AdmissionWindow:
@@ -108,7 +102,9 @@ class AdmissionWindow:
             # waiting only adds latency.
             w = 0.0
         if self.metrics is not None:
-            self.metrics.admission_window.set(round(w * 1e3, 3))
+            # Base-unit seconds (scheduler_admission_window_seconds) —
+            # the old _ms gauge was the metrics lint's first real catch.
+            self.metrics.admission_window.set(round(w, 6))
         if w > 0.0:
             self.coalesce_windows += 1
         else:

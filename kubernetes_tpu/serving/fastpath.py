@@ -57,6 +57,7 @@ from kubernetes_tpu.ops.backend import (
 from kubernetes_tpu.scheduler.plugins.noderesources import (
     insufficient_resources,
 )
+from kubernetes_tpu.utils.locking import check_dispatch_seam
 
 logger = logging.getLogger(__name__)
 
@@ -252,6 +253,7 @@ class SinglePodFastPath:
                 delta[0], delta[1], static["alloc_pods"],
                 static["taint_f"], static["taint_p"], *tail)
             self.resident.adopt(pack)
+        check_dispatch_seam("serving.fastpath.fetch")
         idx = int(np.asarray(idx_d))
         if idx < 0 or idx >= ct.n_real:
             self.no_fit += 1

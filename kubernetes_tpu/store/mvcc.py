@@ -29,11 +29,11 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import os
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Awaitable, Callable, Mapping
 
 from kubernetes_tpu.api.labels import Selector
+from kubernetes_tpu.utils import flags
 from kubernetes_tpu.metrics.registry import WatchMetrics
 from kubernetes_tpu.api.meta import (
     deep_copy,
@@ -196,7 +196,7 @@ BOOKMARK_INTERVAL_S = 5.0
 # delivered object fails loudly instead of silently corrupting the source of
 # truth with no RV bump. deep_copy() rebuilds plain dicts/lists, so copies
 # handed to callers stay mutable.
-_DEBUG_FREEZE = bool(int(os.environ.get("KTPU_DEBUG_FREEZE", "0") or "0"))
+_DEBUG_FREEZE = flags.get("KTPU_DEBUG_FREEZE")
 
 
 def _frozen(*_a, **_k):
@@ -294,8 +294,7 @@ class MVCCStore:
         #: Active by default; KTPU_WATCH_CACHE=0 is the kill switch that
         #: degrades every read to the direct-mvcc path below.
         self.cacher = None
-        if os.environ.get("KTPU_WATCH_CACHE", "1").lower() \
-                not in ("0", "false", "off"):
+        if flags.get("KTPU_WATCH_CACHE"):
             from kubernetes_tpu.store.cacher import Cacher
             self.cacher = Cacher(self)
 
@@ -1023,7 +1022,7 @@ def new_cluster_store(shards: int | None = None):
     under one global RV counter); None resolves the KTPU_SHARDS
     override, default 1 — the classic single store."""
     if shards is None:
-        shards = int(os.environ.get("KTPU_SHARDS", "1") or "1")
+        shards = flags.get("KTPU_SHARDS") or 1
     if shards > 1:
         from kubernetes_tpu.store.sharded import ShardedNodeStore
         store = ShardedNodeStore(shards)

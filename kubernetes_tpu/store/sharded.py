@@ -46,12 +46,12 @@ from __future__ import annotations
 
 import asyncio
 import json
-import os
 import zlib
 from typing import Any, AsyncIterator, Callable, Mapping
 
 from kubernetes_tpu.api.labels import Selector
 from kubernetes_tpu.metrics.registry import WatchMetrics
+from kubernetes_tpu.utils import flags
 from kubernetes_tpu.store.mvcc import (
     DEFAULT_EVENT_WINDOW,
     Event,
@@ -84,11 +84,14 @@ def control_plane_shards(n_nodes: int, override: int | None = None) -> int:
     5k/50k presets keep the r12 single-store path bit-for-bit)."""
     if override is not None:
         return max(1, int(override))
-    env = os.environ.get("KTPU_SHARDS")
-    if env:
-        return max(1, int(env))
-    threshold = int(os.environ.get("KTPU_SHARD_THRESHOLD")
-                    or DEFAULT_SHARD_THRESHOLD)
+    env = flags.get("KTPU_SHARDS")
+    if env is not None:
+        # 0 clamps to 1 like every other value ≤ 1 (the single-store
+        # kill switch), matching new_cluster_store's `or 1` — falling
+        # through to the threshold policy here would hand an 8-shard
+        # prep accounting to a 1-shard store.
+        return max(1, env)
+    threshold = flags.get("KTPU_SHARD_THRESHOLD")
     return DEFAULT_SHARDS if n_nodes >= threshold else 1
 
 

@@ -1,0 +1,189 @@
+"""Central registry of every `KTPU_*` environment flag.
+
+Before this module, 30+ call sites read `os.environ` directly with
+ad-hoc parsing: three different boolean spellings, two different
+defaults for the SAME flag (`KTPU_TRACE_THRESHOLD_MS` defaulted to
+"disabled" in the tracer and to 100 ms in the scheduler), import-time
+reads that silently ignored env changes made after import (the bench
+had to set overrides before importing the backend), and `float(env)` /
+`int(env)` calls that crashed the process on a malformed value.
+
+The registry is the single source of truth: name, default, parser,
+one-line doc, and whether the flag is a structural kill switch. Every
+read in the tree goes through `get()` — a LIVE `os.environ` read per
+call, so tests and the bench can flip knobs between runs — and the
+static-analysis flag pass (`kubernetes_tpu/analysis/flags_pass.py`)
+fails the build on any `KTPU_*` environ read that bypasses it, on
+registry entries without docs or tests, and on a README flag table
+that drifted from `render_markdown_table()`.
+
+Parsing is deliberately forgiving: a malformed value degrades to the
+flag's default (a typo in an env var must never crash a control
+plane), and booleans accept the union of the spellings that grew up in
+the tree ("0"/"false"/"off"/"no", any case, disable).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__all__ = ["Flag", "FLAGS", "get", "get_raw", "scoped_set",
+           "render_markdown_table"]
+
+#: spellings that read as "off" for boolean flags (case-insensitive);
+#: everything else non-empty reads as "on".
+_FALSE = frozenset(("0", "false", "off", "no"))
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() not in _FALSE
+
+
+def _parse_int(raw: str) -> int:
+    return int(raw.strip())
+
+
+def _parse_float(raw: str) -> float:
+    return float(raw.strip())
+
+
+def _parse_ms(raw: str) -> float:
+    return max(0.0, float(raw.strip()))
+
+
+def _parse_str(raw: str) -> str:
+    return raw
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    default: Any
+    parse: Callable[[str], Any] = field(repr=False)
+    doc: str
+    #: structural kill switch: flipping it degrades a subsystem to its
+    #: pre-feature shape (the differential-test contract), rather than
+    #: tuning a knob.
+    kill_switch: bool = False
+
+    def read(self) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None or raw == "":
+            return self.default
+        try:
+            return self.parse(raw)
+        except (ValueError, TypeError):
+            return self.default
+
+
+def _flag(name, default, parse, doc, kill_switch=False) -> Flag:
+    return Flag(name=name, default=default, parse=parse, doc=doc,
+                kill_switch=kill_switch)
+
+
+#: The registry. Order is the README table order: kill switches first,
+#: then tuning overrides, then debug/test knobs.
+FLAGS: dict[str, Flag] = {f.name: f for f in (
+    _flag("KTPU_SERVING", True, _parse_bool,
+          "Online serving tier (admission window + resident planes + "
+          "single-pod fast path). `0` degrades the dispatch loop "
+          "structurally to the pre-serving shape.", kill_switch=True),
+    _flag("KTPU_CLASS_PLANES", True, _parse_bool,
+          "Class-dictionary (C,N) device planes. `0` falls back to "
+          "per-pod planes (C == P identity), bit-identical assignments.",
+          kill_switch=True),
+    _flag("KTPU_WATCH_CACHE", True, _parse_bool,
+          "Watch-cache serving tier (store/cacher.py). `0` degrades "
+          "every LIST/watch to the direct-mvcc path.", kill_switch=True),
+    _flag("KTPU_SHARDS", None, _parse_int,
+          "Control-plane shard count override; `1` is the kill switch "
+          "(plain single MVCCStore). Unset = the node-count threshold "
+          "policy picks.", kill_switch=True),
+    _flag("KTPU_SHARD_THRESHOLD", 100_000, _parse_int,
+          "Node count at which the flagless shard policy switches from "
+          "1 shard to 8 (store/sharded.control_plane_shards)."),
+    _flag("KTPU_CLASS_PAD", 31, _parse_int,
+          "Max real pod-equivalence classes per chunk before the "
+          "per-pod fallback (plane rows bucket to the next power of "
+          "two)."),
+    _flag("KTPU_PIPELINE_DEPTH", None, _parse_int,
+          "Solve-pipeline depth override (chunks in flight ahead of "
+          "the fetch). Unset = the AdaptiveTuner picks from measured "
+          "transfer latency."),
+    _flag("KTPU_SHORTLIST_K", None, _parse_int,
+          "Shortlist width override for the pruned solve; `0` disables "
+          "pruning. Unset = the tuner derives K from chunk width and "
+          "fallback rate."),
+    _flag("KTPU_ADMISSION_WINDOW", None, _parse_ms,
+          "Serving admission coalesce window in MILLISECONDS (pinned "
+          "for sweeps; `0` = always dispatch immediately). Unset = the "
+          "AdaptiveTuner policy row sizes it."),
+    _flag("KTPU_TRACE_THRESHOLD_MS", None, _parse_float,
+          "Slow-attempt threshold in ms: root span trees and attempt "
+          "traces slower than this log a step breakdown. Unset = no "
+          "tree dumps; the scheduler's per-attempt logger falls back "
+          "to the reference's 100 ms."),
+    _flag("KTPU_DATA_DIR", None, _parse_str,
+          "Durability directory (WAL + snapshots); the apiserver "
+          "recovers state from it on construction when set."),
+    _flag("KTPU_LOCK_CHECK", False, _parse_bool,
+          "Runtime lock-order / dispatch-hygiene detector "
+          "(utils/locking.py): instrumented locks record per-thread "
+          "acquisition order and raise on observed inversions and on "
+          "locks held across device-fetch/wire-send seams. Off = "
+          "plain `threading.Lock`, zero overhead."),
+    _flag("KTPU_DEBUG_FREEZE", False, _parse_bool,
+          "Recursively freeze stored/watch-delivered objects so a "
+          "mutating handler fails loudly (enabled by the test suite)."),
+    _flag("KTPU_TEST_PLATFORM", "cpu", _parse_str,
+          "jax platform the test suite runs against (tests/conftest.py; "
+          "set to run the suite on real hardware)."),
+)}
+
+
+def get(name: str) -> Any:
+    """Parsed live read of a registered flag (unset/empty/malformed →
+    the registered default). KeyError on unregistered names — a typo'd
+    flag read should fail loudly, same contract as the static pass."""
+    return FLAGS[name].read()
+
+
+def get_raw(name: str) -> str | None:
+    """The raw environ value of a registered flag (None when unset)."""
+    FLAGS[name]  # unregistered names fail loudly here too
+    return os.environ.get(name)
+
+
+@contextmanager
+def scoped_set(name: str, value):
+    """Set a flag for the duration of a block, restoring the previous
+    value (or unset state) on exit — the save/restore idiom PerfRunner
+    uses to scope a shard-count override to one run."""
+    FLAGS[name]
+    prev = os.environ.get(name)
+    os.environ[name] = str(value)
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
+
+
+def render_markdown_table() -> str:
+    """The README "Flags" table, generated — the flag pass fails when
+    the README's copy drifts from this render."""
+    lines = [
+        "| Flag | Default | Kill switch | What it does |",
+        "|---|---|---|---|",
+    ]
+    for f in FLAGS.values():
+        default = "unset" if f.default is None else str(f.default)
+        ks = "yes" if f.kill_switch else ""
+        doc = " ".join(f.doc.split())
+        lines.append(f"| `{f.name}` | `{default}` | {ks} | {doc} |")
+    return "\n".join(lines)

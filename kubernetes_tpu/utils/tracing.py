@@ -32,9 +32,10 @@ import contextvars
 import itertools
 import json
 import logging
-import os
 import time
 from typing import Any
+
+from kubernetes_tpu.utils import flags
 
 logger = logging.getLogger(__name__)
 
@@ -118,8 +119,7 @@ class Tracer:
         self.enabled = enabled
         self.max_spans = max_spans
         if threshold_ms is None:
-            env = os.environ.get("KTPU_TRACE_THRESHOLD_MS")
-            threshold_ms = float(env) if env else None
+            threshold_ms = flags.get("KTPU_TRACE_THRESHOLD_MS")
         self.threshold_ms = threshold_ms
         # deque(maxlen): O(1) ring-buffer appends — a full list ring
         # would memmove 64k entries per span on the hot path.

@@ -1,0 +1,166 @@
+"""Runtime lock-order / dispatch-hygiene detector (utils/locking.py).
+
+Pins: (a) `new_lock` is a plain `threading.Lock` with the flag off —
+zero overhead, no bookkeeping; (b) with `KTPU_LOCK_CHECK=1` a
+deliberately inverted two-lock pattern raises `LockOrderError` on the
+FIRST inversion (no unlucky interleaving needed); (c) the sanctioned
+dispatch seams raise when entered with an instrumented lock held;
+(d) the metrics registry rides the detector cleanly (its single-lock
+discipline produces no false positives under render-vs-inc load).
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.utils import locking
+from kubernetes_tpu.utils.locking import (
+    InstrumentedLock,
+    LockHeldAcrossDispatchError,
+    LockOrderError,
+    new_lock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    locking.reset_observed()
+    yield
+    locking.reset_observed()
+
+
+class TestZeroOverheadOff:
+    def test_plain_lock_when_disabled(self, monkeypatch):
+        monkeypatch.delenv("KTPU_LOCK_CHECK", raising=False)
+        lk = new_lock("anything")
+        assert not isinstance(lk, InstrumentedLock)
+        assert type(lk) is type(threading.Lock())
+        with lk:
+            # a plain lock never participates in seam checks
+            locking.check_dispatch_seam("test.seam")
+
+    def test_explicit_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "0")
+        assert not isinstance(new_lock("x"), InstrumentedLock)
+
+
+class TestInversionDetection:
+    def test_inverted_two_lock_pattern_raises(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        a, b = new_lock("A"), new_lock("B")
+        assert isinstance(a, InstrumentedLock)
+        with a:
+            with b:
+                pass
+        # the deliberate inversion: B then A
+        with b:
+            with pytest.raises(LockOrderError) as exc:
+                with a:
+                    pass  # pragma: no cover - acquire raises first
+            assert "A" in str(exc.value) and "B" in str(exc.value)
+
+    def test_consistent_order_never_raises(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        a, b = new_lock("A"), new_lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+
+    def test_same_name_instances_exempt(self, monkeypatch):
+        # Counter instances all share the name "metrics.<name>": nesting
+        # two interchangeable instances is not an ordering fact.
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        a1, a2 = new_lock("metrics.same"), new_lock("metrics.same")
+        with a1:
+            with a2:
+                pass
+        with a2:
+            with a1:
+                pass
+
+    def test_inversion_detected_across_threads(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        a, b = new_lock("A"), new_lock("B")
+        with a:
+            with b:
+                pass
+        errors = []
+
+        def invert():
+            try:
+                b.acquire()
+                try:
+                    a.acquire()
+                    a.release()
+                finally:
+                    b.release()
+            except LockOrderError as e:
+                errors.append(e)
+
+        t = threading.Thread(target=invert)
+        t.start()
+        t.join()
+        assert len(errors) == 1
+
+
+class TestDispatchSeam:
+    def test_raises_while_holding(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        lk = new_lock("store.cacher")
+        with lk:
+            with pytest.raises(LockHeldAcrossDispatchError) as exc:
+                locking.check_dispatch_seam("backend.fetch_assign")
+            assert "store.cacher" in str(exc.value)
+        # released: the seam is clean again
+        locking.check_dispatch_seam("backend.fetch_assign")
+
+    def test_held_locks_introspection(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        a, b = new_lock("A"), new_lock("B")
+        assert locking.held_locks() == ()
+        with a:
+            with b:
+                assert locking.held_locks() == ("A", "B")
+        assert locking.held_locks() == ()
+
+
+class TestMetricsIntegration:
+    def test_registry_rides_the_detector(self, monkeypatch):
+        """Counter/Histogram under KTPU_LOCK_CHECK=1: instrumented locks,
+        no false positives from inc-vs-render (the LK205 fix snapshots
+        under the lock, never nests)."""
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        from kubernetes_tpu.metrics.registry import Counter, Histogram
+        c = Counter("test_lockcheck_total", "t", labels=("k",))
+        assert isinstance(c._lock, InstrumentedLock)
+        h = Histogram("test_lockcheck_seconds", "t")
+        done = []
+
+        def writer():
+            for i in range(500):
+                c.inc(k=str(i % 7))
+                h.observe(0.001 * i)
+            done.append(True)
+
+        threads = [threading.Thread(target=writer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        # render concurrently with the writers — the pre-fix registry
+        # raised "dictionary changed size during iteration" here.
+        for _ in range(50):
+            c.render()
+            h.render()
+            h.snapshot()
+        for t in threads:
+            t.join()
+        assert len(done) == 3
+        assert c.render().count("test_lockcheck_total") >= 7
+
+    def test_fetch_seam_clean_after_observe(self, monkeypatch):
+        monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+        from kubernetes_tpu.metrics.registry import Histogram
+        h = Histogram("test_seam_seconds", "t")
+        h.observe(0.5)
+        # observe released its lock — the solve-fetch seam must be clean
+        locking.check_dispatch_seam("backend.fetch_assign")

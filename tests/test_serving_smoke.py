@@ -29,7 +29,20 @@ from kubernetes_tpu.serving import serving_enabled
 from kubernetes_tpu.serving.admission import AdmissionWindow
 from kubernetes_tpu.serving.fastpath import SinglePodFastPath
 from kubernetes_tpu.serving.resident import ResidentPlanes
+from kubernetes_tpu.utils import locking
 from test_tpu_backend import default_fwk
+
+
+@pytest.fixture(autouse=True)
+def _lock_check(monkeypatch):
+    """Tier-1 rides the runtime lock/dispatch-hygiene detector: every
+    lock built while this suite runs is instrumented, and the solve
+    fetch / fast-path fetch / wire flush seams raise if entered with a
+    lock held (utils/locking.py; the static pass's runtime twin)."""
+    monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+    locking.reset_observed()
+    yield
+    locking.reset_observed()
 
 
 def _cluster(n, alloc=None, taint_every=0):

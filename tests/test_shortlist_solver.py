@@ -367,10 +367,12 @@ class TestBackendParity:
         # with no fallback and no poisoning).
         snap, pods = self._cluster_and_pods(9, n_pods=50)
         fwk = default_fwk()
-        monkeypatch.setattr(backend_mod, "_SHORTLIST_K_OVERRIDE", 0)
+        # The override is a LIVE env read now (utils/flags.py), so the
+        # sweep knob is the flag itself — no module-state patching.
+        monkeypatch.setenv("KTPU_SHORTLIST_K", "0")
         full, _ = backend_mod.TPUBackend(
             max_batch=16, mesh=None).assign(pods, snap, fwk)
-        monkeypatch.setattr(backend_mod, "_SHORTLIST_K_OVERRIDE", 16)
+        monkeypatch.setenv("KTPU_SHORTLIST_K", "16")
         b = backend_mod.TPUBackend(max_batch=16, mesh=None)
         b.metrics = SchedulerMetrics()
         sl, _ = b.assign(pods, snap, fwk)

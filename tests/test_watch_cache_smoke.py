@@ -18,6 +18,18 @@ import pytest
 
 from kubernetes_tpu.agent.agent import NodeAgent
 from kubernetes_tpu.store.mvcc import Expired, MVCCStore
+from kubernetes_tpu.utils import locking
+
+
+@pytest.fixture(autouse=True)
+def _lock_check(monkeypatch):
+    """Tier-1 rides the runtime lock/dispatch-hygiene detector (see
+    tests/test_serving_smoke.py): locks built during this suite are
+    instrumented, inversions and held-across-dispatch raise."""
+    monkeypatch.setenv("KTPU_LOCK_CHECK", "1")
+    locking.reset_observed()
+    yield
+    locking.reset_observed()
 
 
 def run(coro):
