@@ -492,6 +492,22 @@ class SchedulerMetrics:
         self.solver_shortlist_fallbacks = r.counter(
             "scheduler_tpu_solver_shortlist_fallbacks_total",
             "Pods whose shortlist bound check fell back to the full row")
+        #: Wavefront-solve observability (r18): the wave width the latest
+        #: chunk solved at (1 = serial scan — kill switch or narrowed
+        #: policy), pods committed speculatively, and pods that fell
+        #: into the exact serial replay. The replay fraction
+        #: replays/(commits+replays) is the signal the AdaptiveTuner's
+        #: width-narrowing rule keys on — recorded data, not a guess.
+        self.solver_wave_width = r.gauge(
+            "scheduler_tpu_solver_wave_width",
+            "Pods evaluated per scan step by the latest chunk's solve")
+        self.solver_wave_commits = r.counter(
+            "scheduler_tpu_solver_wave_commits_total",
+            "Pods committed speculatively by the wavefront solve")
+        self.solver_wave_replays = r.counter(
+            "scheduler_tpu_solver_wave_replays_total",
+            "Pods placed through the wavefront solve's exact serial "
+            "replay")
         #: Sharded-control-plane observability (ROADMAP #5): per-shard
         #: host-prep rebuild counts (a shard increments only when its
         #: rows were actually rewritten — the incremental path's

@@ -237,6 +237,27 @@ class AdaptiveTuner:
     SHORTLIST_FALLBACK_RATIO = 0.25
     #: minimum solved pods before the fallback rate is trusted.
     SHORTLIST_MIN_SAMPLE = 512
+    #: Wavefront policy rows (the r18 speculative solve): W pods per
+    #: scan step, swept at the 5k/50k/200k presets (BASELINE r18). The
+    #: win GROWS with node count — the scan-length cut frees the XLA
+    #: compute threads that contend with the host path, in proportion
+    #: to how big each step's arrays are: 200k median 1508 at W=64 vs
+    #: ~1036 serial (+46%), 50k 1517–1677 across W∈{16,32,64} vs 1411,
+    #: while 5k (full-scan multistart, host-bound) is flat within the
+    #: run spread — W=32 keeps it active without cost, mirroring the
+    #: shortlist's 5k finding. Replay fraction was 0% throughout (all
+    #: template workloads). Node count is STRUCTURAL, so like the
+    #: large-N chunk row the tier applies from the first assign.
+    #: Conflict rate is WORKLOAD-dependent (packing strategies re-pick
+    #: debited nodes; contested spread domains force replays), so the
+    #: width halves at decide() boundaries whenever the measured replay
+    #: fraction crosses the ratio — replays are exact but serial, so a
+    #: persistently-conflicting wave must narrow or the speculation
+    #: overhead is pure waste (the shortlist boost rule, mirrored).
+    WAVE_WIDTH_SMALL = 32
+    WAVE_WIDTH_LARGE = 64
+    WAVE_REPLAY_RATIO = 0.25
+    WAVE_MIN_SAMPLE = 512
     #: Admission-window policy row (the serving tier, ROADMAP #3 — see
     #: serving/admission.py for the state machine that consults it).
     #: Thresholds are seeded from the r15 churn knee sweep (BASELINE
@@ -293,6 +314,12 @@ class AdaptiveTuner:
         self.shortlist_boost = 1
         self.solve_pods = 0
         self.solve_fallbacks = 0
+        #: wavefront feedback state: the policy W divides by wave_shrink
+        #: (replay-fraction feedback can only NARROW the wave; the
+        #: override pins it).
+        self.wave_shrink = 1
+        self.wave_commits = 0
+        self.wave_replays = 0
 
     def probe(self) -> float:
         """Median tiny put+fetch round trip (no jit, pure transfer)."""
@@ -333,6 +360,24 @@ class AdaptiveTuner:
         """Shortlist hit-rate sample from one finalized chunk."""
         self.solve_pods += pods
         self.solve_fallbacks += fallbacks
+
+    def observe_wave(self, commits: int, replays: int) -> None:
+        """Wavefront commit/replay sample from one finalized chunk."""
+        self.wave_commits += commits
+        self.wave_replays += replays
+
+    def wave_width(self, chunk: int) -> int:
+        """Wavefront width for a chunk; 1 = degenerate one-member waves.
+        The KTPU_WAVEFRONT kill switch is routed by the backend (it
+        selects the W=1 scan FUNCTIONS, not a one-member wave), so this
+        is pure width policy: the override, else the swept node-count
+        tier narrowed by the replay-fraction feedback."""
+        override = flags.get("KTPU_WAVE_WIDTH")
+        if override is not None:
+            return max(1, min(override, chunk))
+        w = self.WAVE_WIDTH_LARGE if self.n_nodes >= self.LARGE_N \
+            else self.WAVE_WIDTH_SMALL
+        return max(1, min(w // self.wave_shrink, chunk))
 
     @classmethod
     def fast_path_cap(cls, chunk_wall_s: float, fast_wall_s: float) -> int:
@@ -388,6 +433,16 @@ class AdaptiveTuner:
                     "-> boost x%d", 100.0 * self.solve_fallbacks
                     / self.solve_pods, self.shortlist_boost)
             self.solve_pods = self.solve_fallbacks = 0
+        wave_total = self.wave_commits + self.wave_replays
+        if wave_total >= self.WAVE_MIN_SAMPLE:
+            if self.wave_replays > self.WAVE_REPLAY_RATIO * wave_total \
+                    and self.wave_shrink < self.WAVE_WIDTH_LARGE:
+                self.wave_shrink *= 2
+                logger.info(
+                    "adaptive tuner: wavefront replay fraction %.0f%% "
+                    "-> shrink x%d", 100.0 * self.wave_replays
+                    / wave_total, self.wave_shrink)
+            self.wave_commits = self.wave_replays = 0
         if self.total_chunks < self.WARMUP_CHUNKS:
             # The large-N row rides a STRUCTURAL signal (node count),
             # so it applies from the very first assign — the one
@@ -476,6 +531,50 @@ def compress_score_wire(host_scores: "np.ndarray") -> "np.ndarray":
     return host_scores.astype(np.float16 if amax <= 1024.0 else np.float32)
 
 
+@jax.jit
+def _copy_pack(pack):
+    """Chain-owned copy of a used-state pack: the donated fused solve
+    consumes its carry input, so a buffer someone else keeps (the
+    resident planes' base) must be copied before seeding the chain.
+    Only called when donation is live (see _solve_program)."""
+    return pack + 0
+
+
+#: Lazily-resolved fused program: the chained used-state carry is
+#: DONATED on accelerator backends only. The chain is the buffer's sole
+#: consumer, so donation lets XLA update the (N, 2R+1) carry in place
+#: instead of allocating per chunk. On CPU-jax it is measurably
+#: CATASTROPHIC: input/output aliasing forces each dispatch to wait for
+#: the previous program to release the buffer, serializing the chunk
+#: pipeline the backend exists to overlap — the r18 same-container 50k
+#: before/after measured 1644/1635 (no donation) vs 894–978 (donated)
+#: pods/s, and 200k 1410 vs ~740 (BASELINE r18). Resolved on FIRST
+#: dispatch, not import: jax.default_backend() initializes the jax
+#: runtime, and the platform must stay configurable until then (the
+#: conftest "set platform before jax initializes" contract).
+_SOLVE_PROGRAM = None
+
+
+def _solve_program():
+    global _SOLVE_PROGRAM
+    if _SOLVE_PROGRAM is None:
+        if jax.default_backend() == "cpu":
+            _SOLVE_PROGRAM = _mask_solve_update
+        else:
+            _SOLVE_PROGRAM = partial(
+                jax.jit,
+                static_argnames=("strategy", "use_spread", "shortlist_k",
+                                 "wave_w"),
+                donate_argnums=(1,))(_mask_solve_update.__wrapped__)
+    return _SOLVE_PROGRAM
+
+
+def _donation_live() -> bool:
+    """True when the fused program donates its carry (accelerator
+    backends) — the resident seed must be copied exactly then."""
+    return _solve_program() is not _mask_solve_update
+
+
 def _signature(plugin_name: str, pi: PodInfo) -> str:
     if plugin_name == "NodeName":
         return pi.node_name
@@ -491,7 +590,9 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
     raise KeyError(plugin_name)
 
 
-@partial(jax.jit, static_argnames=("strategy", "use_spread", "shortlist_k"))
+@partial(jax.jit,
+         static_argnames=("strategy", "use_spread", "shortlist_k",
+                          "wave_w"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        cls_idx, exc_col,
                        taint_f_mat, taint_p_mat, class_mask, class_scores,
@@ -501,7 +602,8 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        sp_min_ok, sp_haskey,
                        sp_applies, sp_contrib, perms, gang_onehot,
                        gang_required,
-                       strategy: str, use_spread: bool, shortlist_k: int):
+                       strategy: str, use_spread: bool, shortlist_k: int,
+                       wave_w: int):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -545,9 +647,32 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     by construction (tests/test_shortlist_solver.py is the differential
     guard).
 
-    Returns (assign (P+1,) — last element is the chunk's fallback count —
-    used_pack', fit0 (C,N), taint_ok (C,N), dom_counts'). The diagnostic
-    planes are CLASS-level; consumers gather through cls_idx host-side.
+    wave_w > 1 switches to the SPECULATIVE WAVEFRONT scans: W pods per
+    scan step against the same carry, prefix-distinct argmax commits,
+    and exact serial replay of conflicted waves — assignments stay
+    bit-identical at every W (tests/test_wavefront_solver.py), the scan
+    length drops P → P/W on low-conflict workloads, and W is part of the
+    chunk program key (one compile per (shapes, strategy, spread, K, W)).
+    The spread∩shortlist combination keeps its W=1 scan — wavefront and
+    shortlist compose, spread composes with wavefront, all three
+    together would multiply the replay conditions for a chunk shape the
+    presets never hit. wave_w == 0 is the KTPU_WAVEFRONT kill-switch
+    shape: the pre-wavefront call graph, structurally.
+
+    `used_pack` is DONATED on accelerator backends (the _solve_program
+    variant): the chunk chain is its only consumer — each dispatch
+    consumes the previous chunk's output (or the one-off seed _start
+    uploads/copies), so XLA may update the carry in place instead of
+    allocating a fresh (N, 2R+1) buffer per chunk. On CPU the aliasing
+    serializes the pipeline and donation stays off (measured ~1.7–1.9×
+    worse; see the _solve_program note and BASELINE r18). When donation
+    is live, the resident planes' base pack is never passed here
+    directly (the serving seed is copied first; see _start).
+
+    Returns (assign (P+3,) — the tail is [shortlist fallbacks, wave
+    commits, wave replays] riding the one fetch — used_pack', fit0
+    (C,N), taint_ok (C,N), dom_counts'). The diagnostic planes are
+    CLASS-level; consumers gather through cls_idx host-side.
     """
     # Wire decompression (see _prep_chunk): masks arrive bit-packed
     # uint8 (C, N/8) big-endian, scores float16 — unpack/cast on device
@@ -590,6 +715,8 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     free_pods = alloc_pods - used_pods
     dom_counts2 = dom_counts
     nfall = jnp.int32(0)
+    wave_com = jnp.int32(0)
+    wave_rep = jnp.int32(0)
     n_pad = alloc_q.shape[0]
     if shortlist_k:
         # Shortlist prefilter: chunk-start live scores per pod CLASS
@@ -626,6 +753,15 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                     sp_min_ok, sp_haskey, sp_applies, sp_contrib,
                     sc0, cls_idx, sl_cand, sl_thresh, has_node,
                     rows=cls_idx, exc=exc_col)
+        elif wave_w > 1:
+            a0, dom_counts2, wave_com, wave_rep = \
+                solver.greedy_assign_rescoring_spread_wave(
+                    req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+                    mask, static_scores, fit_col_w, bal_col_mask, shape_u,
+                    shape_s, w_fit, w_bal, strategy, wave_w,
+                    dom_onehot, cid_onehot, dom_counts, max_skew,
+                    sp_min_ok, sp_haskey, sp_applies, sp_contrib,
+                    rows=cls_idx, exc=exc_col)
         else:
             a0, dom_counts2 = solver.greedy_assign_rescoring_spread(
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
@@ -645,13 +781,27 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
             jnp.where(dropped[:, None],
                       dom_onehot[safe] * contrib_d, 0.0), axis=0)
     else:
-        if shortlist_k:
+        if shortlist_k and wave_w > 1:
+            assign, nfall, wave_com, wave_rep = \
+                solver.multistart_greedy_assign_shortlist_wave(
+                    req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
+                    mask, static_scores, fit_col_w, bal_col_mask, shape_u,
+                    shape_s, w_fit, w_bal, strategy, wave_w, perms,
+                    gang_onehot, gang_required, sc0, cls_idx, sl_cand,
+                    sl_thresh, has_node, rows=cls_idx, exc=exc_col)
+        elif shortlist_k:
             assign, nfall = solver.multistart_greedy_assign_shortlist(
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q,
                 mask, static_scores, fit_col_w, bal_col_mask, shape_u,
                 shape_s, w_fit, w_bal, strategy, perms, gang_onehot,
                 gang_required, sc0, cls_idx, sl_cand, sl_thresh, has_node,
                 rows=cls_idx, exc=exc_col)
+        elif wave_w > 1:
+            assign, wave_com, wave_rep = solver.multistart_greedy_assign_wave(
+                req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+                static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+                w_fit, w_bal, strategy, wave_w, perms, gang_onehot,
+                gang_required, rows=cls_idx, exc=exc_col)
         else:
             assign = solver.multistart_greedy_assign(
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
@@ -669,9 +819,11 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     used_pack2 = used_pack + jnp.zeros(
         (n + 1, used_pack.shape[1]), used_pack.dtype
     ).at[tgt].add(jnp.where(hit[:, None], inc, 0))[:n]
-    # The fallback count rides the assign fetch (one transfer, not two):
-    # consumers slice [:p_real] for assignments and [-1] for the count.
-    assign_out = jnp.concatenate([assign, nfall[None]])
+    # The observability tail rides the assign fetch (one transfer, not
+    # four): consumers slice [:p_real] for assignments, then [-3] =
+    # shortlist fallbacks, [-2]/[-1] = wavefront commits/replays.
+    assign_out = jnp.concatenate(
+        [assign, nfall[None], wave_com[None], wave_rep[None]])
     return assign_out, used_pack2, fit0, taint_ok, dom_counts2
 
 
@@ -1597,9 +1749,15 @@ class TPUBackend:
         # tier's resident planes refresh it O(changed) from the cache's
         # dirty set; without them, one fresh full upload per call.
         # Either way the chain's post-chunk arrays are NEW device values
-        # — the resident base is never mutated by a batch.
+        # — the resident base is never mutated by a batch. When the
+        # fused program DONATES its used_pack input (accelerator
+        # backends; see _solve_program), the resident base must be
+        # copied into a chain-owned buffer first or the first chunk
+        # would invalidate the planes the serving tier keeps warm; on
+        # CPU (no donation) the base is safe as a plain input.
         if self.resident is not None:
-            self._dev_used = self.resident.used_pack(ct, snapshot)
+            base = self.resident.used_pack(ct, snapshot)
+            self._dev_used = _copy_pack(base) if _donation_live() else base
         else:
             self._dev_used = self._put(np.concatenate(
                 [ct.used_q, ct.used_nz_q,
@@ -2318,6 +2476,15 @@ class TPUBackend:
         if class_reps is not None:
             shortlist_k = self._tuner.shortlist_k(P, ct.n_real)
 
+        # Wavefront width: 0 = the KTPU_WAVEFRONT kill switch (the W=1
+        # scan functions, structurally), else the tuner's policy W
+        # (override-pinned or replay-feedback-narrowed). W is a static
+        # arg of the fused program, so it is part of the chunk program
+        # key like the shortlist width.
+        wave_w = 0
+        if flags.get("KTPU_WAVEFRONT"):
+            wave_w = self._tuner.wave_width(P)
+
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are
         # PRIORITY-BLOCK-STABLE: pods only move within runs of equal
@@ -2426,6 +2593,7 @@ class TPUBackend:
             "dev_perms": dev_perms, "gang_onehot": gang_onehot,
             "gang_required": gang_required,
             "shortlist_k": shortlist_k,
+            "wave_w": wave_w,
             "scan_width": (shortlist_k + P) if shortlist_k else ct.n_real,
         }
 
@@ -2482,6 +2650,11 @@ class TPUBackend:
                  or (prep["sp_contrib"].any()
                      and prep["chunk_idx"] < ctx.spread_last_gated)))
         prep["spread_used"] = use_spread
+        # spread∩shortlist keeps its W=1 scan (see _mask_solve_update);
+        # pinning the static arg to 0 here avoids minting per-W program
+        # variants that would all route to the same W=1 body.
+        if use_spread and prep["shortlist_k"]:
+            prep["wave_w"] = 0
         if use_spread:
             sp_args = (sp["dev_dom"], sp["dev_cid"], sp["dev_counts"],
                        sp["dev_skew"], sp["dev_min_ok"], sp["dev_haskey"],
@@ -2490,7 +2663,7 @@ class TPUBackend:
         else:
             sp_args = self._spread_dummies(ct.n_pad, batch.req_q.shape[0])
         assign_d, used_pack2, fit0_d, taint_ok_d, dom_counts2 = \
-            _mask_solve_update(
+            _solve_program()(
                 self._dev_static["alloc_q"], self._dev_used,
                 self._dev_static["alloc_pods"], prep["dev_pack"],
                 prep["dev_cls"], prep["dev_exc"],
@@ -2501,6 +2674,7 @@ class TPUBackend:
                 *sp_args,
                 prep["dev_perms"], *self._gang_args(prep, batch),
                 p["strategy"], use_spread, prep["shortlist_k"],
+                prep["wave_w"],
             )
         self._dev_used = used_pack2
         if use_spread:
@@ -2522,15 +2696,25 @@ class TPUBackend:
         assign = assign_np[: batch.p_real]
 
         # Solve-side observability: the fused program appends the chunk's
-        # shortlist fallback count to the assign vector (one fetch). The
-        # tuner's hit-rate feedback widens K when fallbacks climb. A
+        # [shortlist fallbacks, wave commits, wave replays] tail to the
+        # assign vector (one fetch). The tuner's hit-rate feedback widens
+        # K when fallbacks climb and narrows W when replays climb. A
         # poisoned multistart chunk reports the PADDED width — clamp to
         # real pods so rates never exceed 100%.
-        nfall = min(int(assign_np[-1]), batch.p_real)
+        nfall = min(int(assign_np[-3]), batch.p_real)
+        wave_com = min(int(assign_np[-2]), batch.p_real)
+        wave_rep = min(int(assign_np[-1]), batch.p_real)
         if run.get("shortlist_k"):
             self._tuner.observe_solve(batch.p_real, nfall)
+        if run.get("wave_w", 0) > 1:
+            self._tuner.observe_wave(wave_com, wave_rep)
         if self.metrics is not None:
             self.metrics.solver_scan_width.set(run["scan_width"])
+            self.metrics.solver_wave_width.set(max(1, run.get("wave_w", 0)))
+            if wave_com:
+                self.metrics.solver_wave_commits.inc(wave_com)
+            if wave_rep:
+                self.metrics.solver_wave_replays.inc(wave_rep)
             if run.get("shortlist_k"):
                 self.metrics.solver_shortlist_pods.inc(batch.p_real)
                 if nfall:

@@ -11,15 +11,27 @@ same capacity accounting), minus the per-cycle Python/framework overhead.
 
 Two solvers:
 
-- `greedy_assign` — lax.scan over pods in queue (priority) order. Each step
-  masks by remaining capacity, picks argmax(score), debits the chosen node.
+- `greedy_assign` — lax.scan in queue (priority) order. Each step masks
+  by remaining capacity, picks argmax(score), debits the chosen node.
   Deterministic (ties → lowest node index; the host path's seeded reservoir
   tiebreak is equivalent up to tie choice). This is the oracle-equivalent
-  default.
+  default. The serial scan handles one pod per step; the `_wave` variants
+  below handle W pods per step with the same assignments bit-for-bit.
 - `multistart_greedy_assign` — the contention solver: the SAME scan under
   K pod orders in parallel (vmap over permutations), gang all-or-nothing
   masking, keep the order that places the most pods; identity order wins
   ties so uncontended batches equal the oracle bit-for-bit.
+
+Speculative wavefront scans (`*_wave`): the serial scan's length P is the
+wall at scale — every step is a chain of tiny ops dispatched in sequence.
+The wavefront form evaluates W pods per scan step against the SAME carry
+state, commits the wave's prefix-distinct argmax choices speculatively,
+and falls back to an in-step serial replay (`lax.fori_loop` over the
+wave) exactly when a pairwise conflict check cannot prove the speculation
+serial-equivalent — so assignments are **bit-identical at every W** (the
+same contract the shortlist and class-plane scans hold) while the scan
+length drops P → P/W in the low-conflict regime. See
+`greedy_assign_rescoring_wave` for the speculation/replay contract.
 
 Both are shape-static, jit-compiled once per (P, N, R) signature, and emit
 `(P,) int32` node indices with -1 = unschedulable-this-cycle.
@@ -726,10 +738,687 @@ def greedy_assign_rescoring_spread_shortlist(
 
 
 # ---------------------------------------------------------------------------
-# Pinned single-pod fast path (the serving tier's solve, ROADMAP #3):
-# one C=1 class row against the RESIDENT device planes — gather → mask →
-# score → argmax → debit, no scan, no chunk machinery, no shortlist build.
+# Speculative wavefront scans: W pods per scan step with exact conflict
+# replay. The serial scans above are bound by their LENGTH — P sequential
+# steps, each a chain of small ops — while the r14 class planes make a
+# W-wide evaluation of the same step nearly as cheap as a 1-wide one.
+#
+# Per wave step:
+#   1. evaluate all W pods against the same carry state (one (W,·) pass
+#      over the closed-over class planes);
+#   2. pick PREFIX-DISTINCT speculative choices: member w takes the best
+#      node not picked by members 0..w-1 (max score, lowest node index
+#      among ties — the serial argmax rule over the not-yet-debited set);
+#   3. prove each speculation serial-equivalent with a pairwise conflict
+#      check: member w's pick stands iff no earlier member's committed
+#      node, RE-SCORED after its own debit, would beat member w's pick
+#      under the serial (score, lowest-index) order. Debits usually only
+#      lower a node's score (LeastAllocated), but not always (a debit can
+#      RAISE MostAllocated/BalancedAllocation scores and serial greedy
+#      then re-picks the same node) — the check re-scores instead of
+#      assuming monotonicity, so speculation is exact by proof, not hope;
+#   4. commit the whole wave's debits in one scatter when no member
+#      conflicts; otherwise REPLAY the wave serially (lax.fori_loop of
+#      the one-pod step body) — reproducing the serial order exactly.
+#
+# Untouched nodes keep bitwise-identical scores across a wave (the score
+# kernels are elementwise per node), so the only nodes whose serial value
+# can differ from the wave evaluation are the ≤W wave commits — exactly
+# the set the pairwise check re-scores. Assignments are therefore
+# bit-identical to the W=1 scans at every wave width; only the replay
+# fraction (observability, tuner feedback) is workload-dependent.
 # ---------------------------------------------------------------------------
+
+
+def _wave_split(wave_w: int, arrays):
+    """Pad the pod axis to a multiple of wave_w and reshape each array to
+    (n_waves, wave_w, ...) for wave-by-wave scanning. Returns the reshaped
+    arrays plus the matching real-pod mask (padding members never fit,
+    never commit, never conflict) and the padded length."""
+    p = arrays[0].shape[0]
+    pad = (-p) % wave_w
+    out = []
+    for a in arrays:
+        if pad:
+            a = jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+        out.append(a.reshape((-1, wave_w) + a.shape[1:]))
+    real = (jnp.arange(p + pad, dtype=jnp.int32) < p).reshape(-1, wave_w)
+    return out, real, p + pad
+
+
+def _wave_spec_picks(masked, node_of, nbig, wave_w: int):
+    """Prefix-distinct speculative picks for one wave.
+
+    masked: (W, M) candidate scores with NEG_INF = infeasible; node_of:
+    (W, M) int32 global node id per slot (slots may repeat a node — the
+    shortlist candidate set does); nbig: "no pick" sentinel greater than
+    every node id. Member w's pick is the max value over slots whose node
+    no earlier member picked, resolved to the LOWEST node id among ties —
+    the serial argmax rule over the not-yet-debited nodes. The loop is
+    unrolled (W is static) over tiny fused compares; no top-k is involved,
+    so tie resolution is exact even when many slots share the max value.
+
+    Returns (b (W,) f32 scores, y (W,) int32 node ids, nbig = no pick).
+    """
+    bs, ys = [], []
+    for w in range(wave_w):
+        row = masked[w]
+        for yp in ys:
+            row = jnp.where(node_of[w] == yp, NEG_INF, row)
+        b = jnp.max(row)
+        y = jnp.min(jnp.where(row == b, node_of[w], nbig))
+        ys.append(jnp.where(b > NEG_INF, y, nbig).astype(jnp.int32))
+        bs.append(b)
+    return jnp.stack(bs), jnp.stack(ys)
+
+
+def _wave_conflicts(b, y, nbig, req, req_nz, free_q, free_pods, used_nz,
+                    alloc_q, m_pair, stat_pair, fit_col_w, bal_col_mask,
+                    shape_u, shape_s, w_fit, w_bal, strategy,
+                    extra_ok=None):
+    """(W,) conflict bits: member w's speculative pick is invalidated by
+    an earlier member's commit in the same wave.
+
+    For each committed node y_j (j < w), re-score it FOR POD w after pod
+    j's debit (used_nz[y_j] + req_nz_j, free[y_j] - req_j) with the same
+    elementwise kernels the serial step uses; member w conflicts iff some
+    y_j stays feasible for it and beats its pick under the serial order —
+    strictly higher score, or equal score at a lower node index. A member
+    with no pick (b = -inf) conflicts whenever any earlier commit is
+    still feasible for it (serial might place it there). `m_pair`
+    (W, W): pod w's static mask at node y_j; `stat_pair` (W, W): pod w's
+    capacity-independent score at y_j; `extra_ok` optionally folds a
+    variant-specific gate (spread) into feasibility. Prefix-distinct
+    picks never collide, so node identity conflicts cannot occur — only
+    score movement on debited nodes can, and that is exactly what is
+    re-checked.
+    """
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    W = y.shape[0]
+    n = free_q.shape[0]
+    hit = y < nbig
+    safe = jnp.minimum(y, n - 1)
+    fr_j = free_q[safe] - req                                  # (W,R)
+    fp_j = free_pods[safe] - 1                                 # (W,)
+    unz_j = used_nz[safe] + req_nz                             # (W,R)
+    al_j = alloc_q[safe]
+    upd = stat_pair + w_fit * kernels.fit_score(
+        al_j, unz_j, req_nz, fit_col_w, strategy, shape_u, shape_s)
+    upd = upd + w_bal * kernels.balanced_allocation_score(
+        al_j, unz_j, req_nz, bal_col_mask)                     # (W,W)
+    cap = jnp.all(req[:, None, :] <= fr_j[None, :, :], axis=-1)
+    feas = m_pair & cap & (fp_j >= 1)[None, :] & hit[None, :]
+    if extra_ok is not None:
+        feas = feas & extra_ok
+    beats = feas & ((upd > b[:, None])
+                    | ((upd == b[:, None]) & (y[None, :] < y[:, None])))
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+    tri = w_iota[None, :] < w_iota[:, None]                    # j < w
+    return jnp.any(beats & tri, axis=1)
+
+
+def _rescoring_wave_scan(req_q, req_nz_q, free_q, free_pods, used_nz_q,
+                         alloc_q, mask, static_scores, fit_col_w,
+                         bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                         strategy: str, wave_w: int, rows, exc,
+                         poison: bool):
+    """Traceable wavefront core of greedy_assign_rescoring.
+
+    poison=False: conflicted waves take the in-step serial replay branch
+    (a real lax.cond — only taken waves pay it), so the result is exact.
+    poison=True is the vmapped-multistart shape (a cond under vmap lowers
+    to a both-branches select, re-paying the serial wave every step):
+    speculation always commits, the first conflict POISONS the scan, and
+    the caller discards poisoned results — same contract as the shortlist
+    multistart. Returns (assign, commits, replays, poisoned)."""
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = free_q.shape[0]
+    p = req_q.shape[0]
+    W = max(1, min(wave_w, p))
+    iota_n = jnp.arange(n, dtype=jnp.int32)
+    ex = jnp.full((p,), -1, jnp.int32) if exc is None else exc
+    (req_w, req_nz_w, rows_w, ex_w), real_w, _ = _wave_split(
+        W, (req_q, req_nz_q, rows, ex))
+
+    def wave_step(carry, inp):
+        free_q, free_pods, used_nz, ncom, nrep, pois = carry
+        req, req_nz, row, e, real = inp
+        m = mask[row]                                          # (W,N)
+        m = m & ((e < 0)[:, None] | (iota_n[None, :] == e[:, None]))
+        m = m & real[:, None]
+        fits = m & jnp.all(req[:, None, :] <= free_q[None, :, :], axis=-1) \
+            & (free_pods >= 1)[None, :]
+        sc = static_scores[row]
+        sc = sc + w_fit * kernels.fit_score(
+            alloc_q, used_nz, req_nz, fit_col_w, strategy, shape_u, shape_s)
+        sc = sc + w_bal * kernels.balanced_allocation_score(
+            alloc_q, used_nz, req_nz, bal_col_mask)
+        masked = jnp.where(fits, sc, NEG_INF)
+        node_of = jnp.broadcast_to(iota_n[None, :], masked.shape)
+        b, y = _wave_spec_picks(masked, node_of, n, W)
+        safe = jnp.minimum(y, n - 1)
+        conflict = _wave_conflicts(
+            b, y, n, req, req_nz, free_q, free_pods, used_nz, alloc_q,
+            m[:, safe], static_scores[row[:, None], safe[None, :]],
+            fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+            strategy)
+        nreal = jnp.sum(real.astype(jnp.int32))
+
+        def fast(st):
+            fq, fp, unz, nc, nr, po = st
+            hit = y < n
+            fq = fq.at[safe].add(
+                jnp.where(hit[:, None], -req, 0).astype(fq.dtype))
+            fp = fp.at[safe].add(jnp.where(hit, -1, 0).astype(fp.dtype))
+            unz = unz.at[safe].add(
+                jnp.where(hit[:, None], req_nz, 0).astype(unz.dtype))
+            return (fq, fp, unz, nc + nreal, nr, po), \
+                jnp.where(hit, y, jnp.int32(-1))
+
+        if poison:
+            carry2, out = fast((free_q, free_pods, used_nz, ncom, nrep,
+                                pois | jnp.any(conflict)))
+            return carry2, out
+
+        def slow(st):
+            fq, fp, unz, nc, nr, po = st
+
+            def body(w, s):
+                fq, fp, unz, out = s
+                rq, rnz = req[w], req_nz[w]
+                fits_w = m[w] & jnp.all(rq[None, :] <= fq, axis=1) \
+                    & (fp >= 1)
+                scw = static_scores[row[w]]
+                scw = scw + w_fit * kernels.fit_score(
+                    alloc_q, unz, rnz[None, :], fit_col_w, strategy,
+                    shape_u, shape_s)[0]
+                scw = scw + w_bal * kernels.balanced_allocation_score(
+                    alloc_q, unz, rnz[None, :], bal_col_mask)[0]
+                mk = jnp.where(fits_w, scw, NEG_INF)
+                idx = jnp.argmax(mk).astype(jnp.int32)
+                idx = jnp.where(jnp.any(fits_w), idx, jnp.int32(-1))
+                hitw = idx >= 0
+                sf = jnp.clip(idx, 0, n - 1)
+                fq = fq.at[sf].add(jnp.where(hitw, -rq, 0).astype(fq.dtype))
+                fp = fp.at[sf].add(jnp.where(hitw, -1, 0).astype(fp.dtype))
+                unz = unz.at[sf].add(
+                    jnp.where(hitw, rnz, 0).astype(unz.dtype))
+                return (fq, fp, unz, out.at[w].set(idx))
+
+            fq, fp, unz, out = lax.fori_loop(
+                0, W, body, (fq, fp, unz, jnp.full((W,), -1, jnp.int32)))
+            return (fq, fp, unz, nc, nr + nreal, po), out
+
+        return lax.cond(jnp.any(conflict), slow, fast,
+                        (free_q, free_pods, used_nz, ncom, nrep, pois))
+
+    carry0 = (free_q, free_pods, used_nz_q, jnp.int32(0), jnp.int32(0),
+              jnp.bool_(False))
+    (_, _, _, ncom, nrep, pois), out = lax.scan(
+        wave_step, carry0, (req_w, req_nz_w, rows_w, ex_w, real_w))
+    return out.reshape(-1)[:p], ncom, nrep, pois
+
+
+@partial(jax.jit, static_argnames=("strategy", "wave_w"))
+def greedy_assign_rescoring_wave(req_q, req_nz_q, free_q, free_pods,
+                                 used_nz_q, alloc_q, mask, static_scores,
+                                 fit_col_w, bal_col_mask, shape_u, shape_s,
+                                 w_fit, w_bal, strategy: str, wave_w: int,
+                                 rows=None, exc=None):
+    """greedy_assign_rescoring, W pods per scan step (see the wavefront
+    section comment for the speculation/replay contract). Assignments are
+    bit-identical to the W=1 scan at every wave_w; wave_w=1 runs the
+    degenerate one-member wave. Returns (assign (P,), commits int32,
+    replays int32) — the commit/replay split is the tuner's feedback
+    signal (replays are exact but serial)."""
+    if rows is None:
+        rows = jnp.arange(req_q.shape[0], dtype=jnp.int32)
+    assign, ncom, nrep, _ = _rescoring_wave_scan(
+        req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+        static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+        w_fit, w_bal, strategy, wave_w, rows, exc, poison=False)
+    return assign, ncom, nrep
+
+
+@partial(jax.jit, static_argnames=("strategy", "wave_w"))
+def multistart_greedy_assign_wave(req_q, req_nz_q, free_q, free_pods,
+                                  used_nz_q, alloc_q, mask, static_scores,
+                                  fit_col_w, bal_col_mask, shape_u, shape_s,
+                                  w_fit, w_bal, strategy: str, wave_w: int,
+                                  perms, gang_onehot, gang_required,
+                                  rows=None, exc=None):
+    """multistart_greedy_assign with wavefront scans under the vmap.
+
+    The K permuted scans run vmapped, so the per-wave replay cond would
+    lower to a both-branches select — instead every order runs
+    speculation-only and POISONS on its first conflict, and one outer
+    lax.cond (a real branch) reruns the whole chunk through the W=1
+    multistart when any order was poisoned (the shortlist-multistart
+    contract). Returns (assign (P,), commits int32, replays int32);
+    counters are whole-chunk on the poisoned path (P replays)."""
+    P = req_q.shape[0]
+    arange_p = jnp.arange(P, dtype=jnp.int32)
+    if rows is None:
+        rows = arange_p
+
+    def one(perm):
+        a, _, _, pois = _rescoring_wave_scan(
+            req_q[perm], req_nz_q[perm], free_q, free_pods, used_nz_q,
+            alloc_q, mask, static_scores, fit_col_w, bal_col_mask,
+            shape_u, shape_s, w_fit, w_bal, strategy, wave_w, rows[perm],
+            None if exc is None else exc[perm], poison=True)
+        inv = jnp.zeros_like(perm).at[perm].set(arange_p)
+        return a[inv], pois
+
+    assigns, pois = jax.vmap(one)(perms)
+    any_pois = jnp.any(pois)
+
+    def full(_):
+        return _multistart_body(
+            req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+            static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+            w_fit, w_bal, strategy, perms, gang_onehot, gang_required,
+            rows, exc)
+
+    def take(_):
+        return _select_best(assigns, req_q, gang_onehot, gang_required)
+
+    assign = lax.cond(any_pois, full, take, None)
+    ncom = jnp.where(any_pois, jnp.int32(0), jnp.int32(P))
+    nrep = jnp.where(any_pois, jnp.int32(P), jnp.int32(0))
+    return assign, ncom, nrep
+
+
+@partial(jax.jit, static_argnames=("strategy", "wave_w"))
+def greedy_assign_rescoring_spread_wave(req_q, req_nz_q, free_q, free_pods,
+                                        used_nz_q, alloc_q, mask,
+                                        static_scores, fit_col_w,
+                                        bal_col_mask, shape_u, shape_s,
+                                        w_fit, w_bal, strategy: str,
+                                        wave_w: int,
+                                        dom_onehot, cid_onehot, dom_counts,
+                                        max_skew, min_ok, has_key_nc,
+                                        applies, contributes, rows=None,
+                                        exc=None):
+    """greedy_assign_rescoring_spread, W pods per scan step with per-wave
+    domain-count updates.
+
+    Spread gating is NON-monotone in the carry — a commit that moves a
+    domain count can OPEN another domain for later pods (the global-min
+    rise), so an earlier commit can change a later member's feasible SET
+    upward, which the capacity/score conflict check cannot see. The
+    conflict predicate therefore adds the exact structural rule: member w
+    replays whenever any earlier member committed a placement that moves
+    any domain count (contributes to any constraint) AND member w carries
+    a gating constraint itself; gate-free members (applies all-zero) ride
+    the capacity/score rule alone, with the wave-start spread gate folded
+    into the pairwise feasibility. Domain counts commit per wave (exact:
+    counts are small integers in f32, addition order immaterial).
+    Returns (assign (P,), dom_counts', commits, replays)."""
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = free_q.shape[0]
+    p = req_q.shape[0]
+    W = max(1, min(wave_w, p))
+    big = jnp.float32(1e30)
+    iota_n = jnp.arange(n, dtype=jnp.int32)
+    in_dom_nc = (dom_onehot @ cid_onehot) > 0                          # (N,C)
+    gate_nc = has_key_nc > 0
+    if rows is None:
+        rows = jnp.arange(p, dtype=jnp.int32)
+    ex = jnp.full((p,), -1, jnp.int32) if exc is None else exc
+    (req_w, req_nz_w, rows_w, app_w, con_w, ex_w), real_w, _ = _wave_split(
+        W, (req_q, req_nz_q, rows, applies, contributes, ex))
+
+    def spread_gate(dcounts, contrib, app):
+        """(W,N) DoNotSchedule gate at the given counts — the serial
+        step's gate, batched over the wave (each member folds its own
+        selfMatch term)."""
+        min_c = jnp.min(
+            jnp.where(cid_onehot > 0, dcounts[:, None], big), axis=0)
+        min_c = min_c * min_ok                                         # (C,)
+        self_d = contrib @ cid_onehot.T                                # (W,D)
+        allowed_d = (dcounts[None, :] + self_d
+                     - (cid_onehot @ min_c)[None, :]) \
+            <= (cid_onehot @ max_skew)[None, :]                        # (W,D)
+        in_allowed = jnp.einsum(
+            "nd,wdc->wnc", dom_onehot,
+            allowed_d[:, :, None] * cid_onehot[None, :, :]) > 0        # (W,N,C)
+        node_c_ok = gate_nc[None, :, :] \
+            & (in_allowed | jnp.logical_not(in_dom_nc)[None, :, :])
+        return jnp.all(node_c_ok | (app[:, None, :] == 0), axis=2)     # (W,N)
+
+    def wave_step(carry, inp):
+        free_q, free_pods, used_nz, dcounts, ncom, nrep = carry
+        req, req_nz, row, app, contrib, e, real = inp
+        m = mask[row]
+        m = m & ((e < 0)[:, None] | (iota_n[None, :] == e[:, None]))
+        m = m & real[:, None]
+        sp_ok = spread_gate(dcounts, contrib, app)                     # (W,N)
+        fits = m & sp_ok \
+            & jnp.all(req[:, None, :] <= free_q[None, :, :], axis=-1) \
+            & (free_pods >= 1)[None, :]
+        sc = static_scores[row]
+        sc = sc + w_fit * kernels.fit_score(
+            alloc_q, used_nz, req_nz, fit_col_w, strategy, shape_u, shape_s)
+        sc = sc + w_bal * kernels.balanced_allocation_score(
+            alloc_q, used_nz, req_nz, bal_col_mask)
+        masked = jnp.where(fits, sc, NEG_INF)
+        node_of = jnp.broadcast_to(iota_n[None, :], masked.shape)
+        b, y = _wave_spec_picks(masked, node_of, n, W)
+        safe = jnp.minimum(y, n - 1)
+        hit = y < n
+        conflict = _wave_conflicts(
+            b, y, n, req, req_nz, free_q, free_pods, used_nz, alloc_q,
+            m[:, safe], static_scores[row[:, None], safe[None, :]],
+            fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+            strategy, extra_ok=sp_ok[:, safe])
+        # The structural non-monotonicity rule: any earlier count-moving
+        # commit forces gated members into the serial replay.
+        movers = hit & jnp.any(contrib > 0, axis=1)                    # (W,)
+        earlier_moved = jnp.cumsum(movers.astype(jnp.int32)) \
+            - movers.astype(jnp.int32) > 0
+        conflict = conflict | (earlier_moved & jnp.any(app > 0, axis=1))
+        nreal = jnp.sum(real.astype(jnp.int32))
+
+        def fast(st):
+            fq, fp, unz, dc, nc, nr = st
+            fq = fq.at[safe].add(
+                jnp.where(hit[:, None], -req, 0).astype(fq.dtype))
+            fp = fp.at[safe].add(jnp.where(hit, -1, 0).astype(fp.dtype))
+            unz = unz.at[safe].add(
+                jnp.where(hit[:, None], req_nz, 0).astype(unz.dtype))
+            add = jnp.where(hit[:, None],
+                            dom_onehot[safe] * (contrib @ cid_onehot.T),
+                            0.0)                                       # (W,D)
+            dc = dc + jnp.sum(add, axis=0)
+            return (fq, fp, unz, dc, nc + nreal, nr), \
+                jnp.where(hit, y, jnp.int32(-1))
+
+        def slow(st):
+            fq, fp, unz, dc, nc, nr = st
+
+            def body(w, s):
+                fq, fp, unz, dc, out = s
+                rq, rnz = req[w], req_nz[w]
+                sp_w = spread_gate(dc, contrib[w][None, :],
+                                   app[w][None, :])[0]
+                fits_w = m[w] & sp_w \
+                    & jnp.all(rq[None, :] <= fq, axis=1) & (fp >= 1)
+                scw = static_scores[row[w]]
+                scw = scw + w_fit * kernels.fit_score(
+                    alloc_q, unz, rnz[None, :], fit_col_w, strategy,
+                    shape_u, shape_s)[0]
+                scw = scw + w_bal * kernels.balanced_allocation_score(
+                    alloc_q, unz, rnz[None, :], bal_col_mask)[0]
+                mk = jnp.where(fits_w, scw, NEG_INF)
+                idx = jnp.argmax(mk).astype(jnp.int32)
+                idx = jnp.where(jnp.any(fits_w), idx, jnp.int32(-1))
+                hitw = idx >= 0
+                sf = jnp.clip(idx, 0, n - 1)
+                fq = fq.at[sf].add(jnp.where(hitw, -rq, 0).astype(fq.dtype))
+                fp = fp.at[sf].add(jnp.where(hitw, -1, 0).astype(fp.dtype))
+                unz = unz.at[sf].add(
+                    jnp.where(hitw, rnz, 0).astype(unz.dtype))
+                dc = dc + jnp.where(
+                    hitw, dom_onehot[sf] * (cid_onehot @ contrib[w]), 0.0)
+                return (fq, fp, unz, dc, out.at[w].set(idx))
+
+            fq, fp, unz, dc, out = lax.fori_loop(
+                0, W, body,
+                (fq, fp, unz, dc, jnp.full((W,), -1, jnp.int32)))
+            return (fq, fp, unz, dc, nc, nr + nreal), out
+
+        return lax.cond(jnp.any(conflict), slow, fast,
+                        (free_q, free_pods, used_nz, dcounts, ncom, nrep))
+
+    carry0 = (free_q, free_pods, used_nz_q, dom_counts,
+              jnp.int32(0), jnp.int32(0))
+    (_, _, _, dom_counts2, ncom, nrep), out = lax.scan(
+        wave_step, carry0,
+        (req_w, req_nz_w, rows_w, app_w, con_w, ex_w, real_w))
+    return out.reshape(-1)[:p], dom_counts2, ncom, nrep
+
+
+def _shortlist_wave_scan(req_q, req_nz_q, rows, free_q, free_pods,
+                         used_nz_q, alloc_q, mask, static_scores, fit_col_w,
+                         bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                         strategy: str, wave_w: int,
+                         sc0, sl_class, sl_cand, sl_thresh, has_node,
+                         poison: bool, exc=None):
+    """_shortlist_scan with W pods per wave step.
+
+    The wave evaluates each member's candidate set (its top-K shortlist ∪
+    every node debited this chunk) against the same carry, takes
+    prefix-distinct picks, and speculation must clear BOTH proofs:
+
+    - the shortlist bound check (the W=1 `trusted` rule verbatim): the
+      pick beats the prefilter threshold, so no node OUTSIDE the
+      candidate set can be the serial winner;
+    - the pairwise wave check (_wave_conflicts): no same-wave earlier
+      commit, re-scored after its debit, beats the pick — covering the
+      nodes whose serial value moved since the wave evaluation.
+
+    A member failing either falls into the serial replay, which runs the
+    full N-wide row (exact regardless of why the bound failed); replays
+    count into `fallbacks` — they pay the same O(N) a W=1 bound-check
+    fallback pays. Chunk-touched candidates are already evaluated LIVE
+    against the carry (the `touched` gather), so wave-start candidate
+    values equal serial values everywhere except same-wave commits.
+    poison semantics as _rescoring_wave_scan (the vmapped multistart
+    shape). Returns (assign, fallbacks, commits, replays, poisoned)."""
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+
+    n = free_q.shape[0]
+    p = req_q.shape[0]
+    W = max(1, min(wave_w, p))
+    iota_n = jnp.arange(n, dtype=jnp.int32)
+    ex = jnp.full((p,), -1, jnp.int32) if exc is None else exc
+    (req_w, req_nz_w, rows_w, cand_w, t_w, cls_w, hn_w, ex_w), real_w, \
+        p_pad = _wave_split(
+            W, (req_q, req_nz_q, rows, sl_cand, sl_thresh, sl_class,
+                has_node, ex))
+
+    def live_scores(ci, row, cls, req_nz, used_nz, touched):
+        """(W,M) candidate scores: live recompute for touched nodes,
+        chunk-start sc0 gather for untouched (the W=1 float-consistency
+        rule — the == threshold comparison never straddles two
+        evaluations of the same quantity)."""
+        live = static_scores[row[:, None], ci]
+        live = live + w_fit * jax.vmap(
+            lambda a, u, rn: kernels.fit_score(
+                a, u, rn[None, :], fit_col_w, strategy, shape_u,
+                shape_s)[0])(alloc_q[ci], used_nz[ci], req_nz)
+        live = live + w_bal * jax.vmap(
+            lambda a, u, rn: kernels.balanced_allocation_score(
+                a, u, rn[None, :], bal_col_mask)[0])(
+                    alloc_q[ci], used_nz[ci], req_nz)
+        return jnp.where(touched[ci], live, sc0[cls[:, None], ci])
+
+    def wave_step(carry, inp):
+        (free_q, free_pods, used_nz, touched, tidx, kstep, nfall,
+         ncom, nrep, pois) = carry
+        req, req_nz, row, cand, t, cls, hn, e, real = inp
+        cset = jnp.concatenate(
+            [cand, jnp.broadcast_to(tidx[None, :], (W, p_pad))], axis=1)
+        valid = cset < n
+        ci = jnp.where(valid, cset, 0)                          # (W,M)
+        live = live_scores(ci, row, cls, req_nz, used_nz, touched)
+        fits = mask[row[:, None], ci] & valid \
+            & jnp.all(req[:, None, :] <= free_q[ci], axis=-1) \
+            & (free_pods[ci] >= 1) \
+            & ((e < 0)[:, None] | (ci == e[:, None])) \
+            & real[:, None]
+        masked = jnp.where(fits, live, NEG_INF)
+        b, y = _wave_spec_picks(masked, ci, n, W)
+        safe = jnp.minimum(y, n - 1)
+        hit = y < n
+        # The W=1 trusted rule on each member's pick (chunk-touched
+        # status at wave start; picks are never same-wave commits).
+        trusted = jnp.where(
+            hit,
+            (b > t) | ((b == t) & jnp.logical_not(touched[safe])),
+            t == NEG_INF) | jnp.logical_not(hn)
+        conflict = jnp.logical_not(trusted) | _wave_conflicts(
+            b, y, n, req, req_nz, free_q, free_pods, used_nz, alloc_q,
+            mask[row[:, None], safe[None, :]]
+            & ((e < 0)[:, None] | (safe[None, :] == e[:, None]))
+            & real[:, None],
+            static_scores[row[:, None], safe[None, :]],
+            fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+            strategy)
+        nreal = jnp.sum(real.astype(jnp.int32))
+
+        def fast(st):
+            (fq, fp, unz, tch, tix, ks, nf, nc, nr, po) = st
+            fq = fq.at[safe].add(
+                jnp.where(hit[:, None], -req, 0).astype(fq.dtype))
+            fp = fp.at[safe].add(jnp.where(hit, -1, 0).astype(fp.dtype))
+            unz = unz.at[safe].add(
+                jnp.where(hit[:, None], req_nz, 0).astype(unz.dtype))
+            # max-combine, NOT read-modify-write set: every no-pick
+            # member aliases index n-1 through `safe`, and a duplicate-
+            # index .set() scatter leaves which update wins unspecified
+            # — a stale False could erase a same-wave commit's mark.
+            tch = tch.at[safe].max(hit)
+            tix = lax.dynamic_update_slice(
+                tix, jnp.where(hit, y, n), (ks,))
+            return (fq, fp, unz, tch, tix, ks + W, nf, nc + nreal, nr,
+                    po), jnp.where(hit, y, jnp.int32(-1))
+
+        if poison:
+            carry2, out = fast(
+                (free_q, free_pods, used_nz, touched, tidx, kstep, nfall,
+                 ncom, nrep, pois | jnp.any(conflict)))
+            return carry2, out
+
+        def slow(st):
+            (fq, fp, unz, tch, tix, ks, nf, nc, nr, po) = st
+
+            def body(w, s):
+                fq, fp, unz, tch, tix, out = s
+                rq, rnz = req[w], req_nz[w]
+                fits_n = mask[row[w]] & real[w] \
+                    & jnp.all(rq[None, :] <= fq, axis=1) & (fp >= 1) \
+                    & ((e[w] < 0) | (iota_n == e[w]))
+                scw = static_scores[row[w]]
+                scw = scw + w_fit * kernels.fit_score(
+                    alloc_q, unz, rnz[None, :], fit_col_w, strategy,
+                    shape_u, shape_s)[0]
+                scw = scw + w_bal * kernels.balanced_allocation_score(
+                    alloc_q, unz, rnz[None, :], bal_col_mask)[0]
+                mk = jnp.where(fits_n, scw, NEG_INF)
+                idx = jnp.argmax(mk).astype(jnp.int32)
+                idx = jnp.where(jnp.any(fits_n), idx, jnp.int32(-1))
+                hitw = idx >= 0
+                sf = jnp.clip(idx, 0, n - 1)
+                fq = fq.at[sf].add(jnp.where(hitw, -rq, 0).astype(fq.dtype))
+                fp = fp.at[sf].add(jnp.where(hitw, -1, 0).astype(fp.dtype))
+                unz = unz.at[sf].add(
+                    jnp.where(hitw, rnz, 0).astype(unz.dtype))
+                tch = tch.at[sf].set(tch[sf] | hitw)
+                tix = tix.at[ks + w].set(jnp.where(hitw, idx, n))
+                return (fq, fp, unz, tch, tix, out.at[w].set(idx))
+
+            fq, fp, unz, tch, tix, out = lax.fori_loop(
+                0, W, body,
+                (fq, fp, unz, tch, tix, jnp.full((W,), -1, jnp.int32)))
+            return (fq, fp, unz, tch, tix, ks + W, nf + nreal, nc,
+                    nr + nreal, po), out
+
+        return lax.cond(
+            jnp.any(conflict), slow, fast,
+            (free_q, free_pods, used_nz, touched, tidx, kstep, nfall,
+             ncom, nrep, pois))
+
+    carry0 = (free_q, free_pods, used_nz_q,
+              jnp.zeros((n,), jnp.bool_),
+              jnp.full((p_pad,), n, jnp.int32),
+              jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+              jnp.bool_(False))
+    (_, _, _, _, _, _, nfall, ncom, nrep, pois), out = lax.scan(
+        wave_step, carry0,
+        (req_w, req_nz_w, rows_w, cand_w, t_w, cls_w, hn_w, ex_w, real_w))
+    return out.reshape(-1)[:p], nfall, ncom, nrep, pois
+
+
+@partial(jax.jit, static_argnames=("strategy", "wave_w"))
+def greedy_assign_rescoring_shortlist_wave(req_q, req_nz_q, free_q,
+                                           free_pods, used_nz_q, alloc_q,
+                                           mask, static_scores, fit_col_w,
+                                           bal_col_mask, shape_u, shape_s,
+                                           w_fit, w_bal, strategy: str,
+                                           wave_w: int,
+                                           sc0, sl_class, sl_cand,
+                                           sl_thresh, has_node, rows=None,
+                                           exc=None):
+    """greedy_assign_rescoring_shortlist with wavefront waves: exact via
+    the in-step serial replay (full N-wide rows, counted as fallbacks).
+    Returns (assign (P,), fallbacks, commits, replays)."""
+    if rows is None:
+        rows = jnp.arange(req_q.shape[0], dtype=jnp.int32)
+    assign, nfall, ncom, nrep, _ = _shortlist_wave_scan(
+        req_q, req_nz_q, rows, free_q, free_pods, used_nz_q, alloc_q,
+        mask, static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+        w_fit, w_bal, strategy, wave_w, sc0, sl_class, sl_cand, sl_thresh,
+        has_node, poison=False, exc=exc)
+    return assign, nfall, ncom, nrep
+
+
+@partial(jax.jit, static_argnames=("strategy", "wave_w"))
+def multistart_greedy_assign_shortlist_wave(req_q, req_nz_q, free_q,
+                                            free_pods, used_nz_q, alloc_q,
+                                            mask, static_scores, fit_col_w,
+                                            bal_col_mask, shape_u, shape_s,
+                                            w_fit, w_bal, strategy: str,
+                                            wave_w: int, perms,
+                                            gang_onehot, gang_required,
+                                            sc0, sl_class, sl_cand,
+                                            sl_thresh, has_node, rows=None,
+                                            exc=None):
+    """multistart_greedy_assign_shortlist with wavefront waves under the
+    vmap: each order runs speculation-only and poisons on its first wave
+    conflict OR failed bound check; one outer lax.cond reruns the whole
+    chunk through the W=1 full multistart when any order was poisoned.
+    Returns (assign (P,), fallback_pods, commits, replays) — fallback
+    and replay accounting is whole-chunk here, like the W=1 variant."""
+    P = req_q.shape[0]
+    arange_p = jnp.arange(P, dtype=jnp.int32)
+    if rows is None:
+        rows = arange_p
+
+    def one(perm):
+        a, _, _, _, pois = _shortlist_wave_scan(
+            req_q[perm], req_nz_q[perm], rows[perm], free_q, free_pods,
+            used_nz_q, alloc_q, mask, static_scores, fit_col_w,
+            bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy, wave_w,
+            sc0, sl_class[perm], sl_cand[perm], sl_thresh[perm],
+            has_node[perm], poison=True,
+            exc=None if exc is None else exc[perm])
+        inv = jnp.zeros_like(perm).at[perm].set(arange_p)
+        return a[inv], pois
+
+    assigns, pois = jax.vmap(one)(perms)
+    any_pois = jnp.any(pois)
+
+    def full(_):
+        return _multistart_body(
+            req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
+            static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
+            w_fit, w_bal, strategy, perms, gang_onehot, gang_required,
+            rows, exc)
+
+    def take(_):
+        return _select_best(assigns, req_q, gang_onehot, gang_required)
+
+    assign = lax.cond(any_pois, full, take, None)
+    nfall = jnp.where(any_pois, jnp.int32(P), jnp.int32(0))
+    ncom = jnp.where(any_pois, jnp.int32(0), jnp.int32(P))
+    nrep = jnp.where(any_pois, jnp.int32(P), jnp.int32(0))
+    return assign, nfall, ncom, nrep
 
 def _solve_one_core(alloc_q, used_pack, alloc_pods, taint_f_mat,
                     taint_p_mat, mask_bits, host_scores, req_pack,

@@ -130,7 +130,8 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                           fit_col_w, bal_col_mask, shape_u, shape_s,
                           w_fit, w_bal, strategy: str,
                           shortlist_k: int = 0, rows=None, exc=None,
-                          row_req_q=None, row_req_nz_q=None):
+                          row_req_q=None, row_req_nz_q=None,
+                          wave_w: int = 0):
     """Sequential-equivalent greedy with live re-scoring, node axis sharded.
 
     Per scan step: shard-local candidate (max score, min index among ties) →
@@ -155,13 +156,26 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
     vectors, used by the shard-local prefilter so it too runs over C
     rows), and optionally `exc` ((P,) GLOBAL single-allowed-column
     exception, -1 = none). Defaults reproduce the per-pod form
-    (rows = arange, row_req = req)."""
+    (rows = arange, row_req = req).
+
+    wave_w > 1 runs the SPECULATIVE WAVEFRONT form of the same solver
+    (the r18 scan — see ops/solver.py): W pods per scan step, each
+    wave's prefix-distinct argmax resolved under the SAME per-step
+    `pmax`/`pmin` shard reduction (W rounds per wave instead of one per
+    pod), conflicts detected in GLOBAL node coordinates (each commit's
+    owner shard re-scores it for later members; the (W,) conflict bits
+    OR-reduce across the mesh so every shard takes the same
+    fast-commit/serial-replay branch) — assignments bit-identical to the
+    serial sharded scan at every W and every shard count. Composes with
+    class planes and exceptions; the shortlist path keeps its W=1 scan
+    (shortlist_k wins when both are set)."""
     n_shards = mesh.shape[NODES_AXIS]
     n_total = free_q.shape[0]
     assert n_total % n_shards == 0, (n_total, n_shards)
     local_n = n_total // n_shards
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
-    run = _solver_fn(mesh, strategy, local_n, shortlist_k=max(k, 0))
+    run = _solver_fn(mesh, strategy, local_n, shortlist_k=max(k, 0),
+                     wave_w=0 if k else max(0, wave_w))
     p = req_q.shape[0]
     if rows is None:
         rows = jnp.arange(p, dtype=jnp.int32)
@@ -179,15 +193,152 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                jnp.float32(w_fit), jnp.float32(w_bal))
 
 
+def _wave_body(mesh, axes, local_n, base, iota, strategy, wave_w,
+               local_full, _reduce,
+               req_q, req_nz_q, rows, exc, free_q, free_pods, used_nz,
+               alloc_q, mask, static_sc, fit_col_w, bal_col_mask,
+               shape_u, shape_s, w_fit, w_bal):
+    """The wavefront wave-step body of the sharded solver (traced inside
+    the shard_map `run`; see sharded_greedy_assign's wave_w contract).
+
+    Per wave: ONE shard-local (W, local_n) evaluation against the carry,
+    then W prefix-distinct global argmax rounds (the same `pmax`→`pmin`
+    winner reduction the serial step runs once per pod, with earlier
+    picks masked out on their owner shard), a conflict check in GLOBAL
+    coordinates — each pick's owner shard re-scores it after its debit
+    for every later member, and the (W,W) beats matrix OR-reduces over
+    the mesh into replicated (W,) conflict bits — and a replicated-
+    predicate cond: fast vectorized commit (owners scatter their picks'
+    debits) or the serial replay (the one-pod step body, W times, exact).
+    Speculative picks and the replay share the serial tie rule (lowest
+    GLOBAL node index among max scorers), so assignments match the
+    serial sharded scan bit-for-bit at every W and shard count."""
+    from kubernetes_tpu.ops.solver import _wave_split
+
+    p = req_q.shape[0]
+    W = max(1, min(wave_w, p))
+    ex = jnp.full((p,), -1, jnp.int32) if exc is None else exc
+    (req_w, req_nz_w, rows_w, ex_w), real_w, _ = _wave_split(
+        W, (req_q, req_nz_q, rows, ex))
+    w_iota = jnp.arange(W, dtype=jnp.int32)
+
+    def wave_step(carry, inp):
+        free_q, free_pods, used_nz = carry
+        req, req_nz, row, e, real = inp
+        el = e - base                                   # local exc coords
+        m = mask[row] \
+            & ((e < 0)[:, None] | (iota[None, :] == el[:, None])) \
+            & real[:, None]                             # (W, local_n)
+        fits = m & jnp.all(req[:, None, :] <= free_q[None, :, :], axis=-1) \
+            & (free_pods >= 1)[None, :]
+        sc = static_sc[row]
+        sc = sc + w_fit * kernels.fit_score(
+            alloc_q, used_nz, req_nz, fit_col_w, strategy, shape_u, shape_s)
+        sc = sc + w_bal * kernels.balanced_allocation_score(
+            alloc_q, used_nz, req_nz, bal_col_mask)
+        masked = jnp.where(fits, sc, -jnp.inf)
+        # Prefix-distinct GLOBAL picks: per member, one local max with
+        # earlier picks masked out (owner shard), then the serial step's
+        # pmax/pmin winner reduction.
+        bs, ys = [], []
+        for w in range(W):
+            rv = masked[w]
+            for yp in ys:
+                rv = jnp.where(iota + base == yp, -jnp.inf, rv)
+            lbest = jnp.max(rv)
+            lidx = jnp.min(jnp.where(rv == lbest, iota, local_n))
+            gbest = _reduce(lbest, lax.pmax)
+            gcand = jnp.where((lidx < local_n) & (lbest >= gbest),
+                              lidx + base, _INT_MAX)
+            gidx = _reduce(gcand, lax.pmin)
+            ys.append(jnp.where(jnp.isfinite(gbest), gidx, _INT_MAX))
+            bs.append(gbest)
+        b = jnp.stack(bs)
+        y = jnp.stack(ys)                               # global ids
+        hit = y < _INT_MAX
+        li = y - base
+        own = (li >= 0) & (li < local_n)                # pick owner bits
+        safe = jnp.clip(li, 0, local_n - 1)
+        # Conflicts in global coordinates: the owner of each pick y_j
+        # re-scores it after member j's debit for every later member w;
+        # non-owners contribute False and the bits OR-reduce replicated.
+        fr_j = free_q[safe] - req                       # (W,R) owner-valid
+        fp_j = free_pods[safe] - 1
+        unz_j = used_nz[safe] + req_nz
+        al_j = alloc_q[safe]
+        upd = static_sc[row[:, None], safe[None, :]] \
+            + w_fit * kernels.fit_score(
+                al_j, unz_j, req_nz, fit_col_w, strategy, shape_u, shape_s) \
+            + w_bal * kernels.balanced_allocation_score(
+                al_j, unz_j, req_nz, bal_col_mask)      # (W,W)
+        cap = jnp.all(req[:, None, :] <= fr_j[None, :, :], axis=-1)
+        feas = m[:, safe] & cap & (fp_j >= 1)[None, :] \
+            & (hit & own)[None, :]
+        beats = feas & ((upd > b[:, None])
+                        | ((upd == b[:, None]) & (y[None, :] < y[:, None])))
+        tri = w_iota[None, :] < w_iota[:, None]
+        conflict_local = jnp.any(beats & tri, axis=1).astype(jnp.int32)
+        conflict = _reduce(conflict_local, lax.pmax) > 0
+
+        def fast(st):
+            fq, fp, unz = st
+            inb = own & hit
+            fq = fq.at[safe].add(
+                jnp.where(inb[:, None], -req, 0).astype(fq.dtype))
+            fp = fp.at[safe].add(jnp.where(inb, -1, 0).astype(fp.dtype))
+            unz = unz.at[safe].add(
+                jnp.where(inb[:, None], req_nz, 0).astype(unz.dtype))
+            return (fq, fp, unz), \
+                jnp.where(hit, y, jnp.int32(-1)).astype(jnp.int32)
+
+        def slow(st):
+            fq, fp, unz = st
+
+            def body(w, s):
+                fq, fp, unz, out = s
+                m_w = mask[row[w]] \
+                    & ((e[w] < 0) | (iota == el[w])) & real[w]
+                lbest, lidx = local_full(req[w], req_nz[w], m_w,
+                                         static_sc[row[w]], fq, fp, unz)
+                gbest = _reduce(lbest, lax.pmax)
+                gcand = jnp.where((lidx < local_n) & (lbest >= gbest),
+                                  lidx + base, _INT_MAX)
+                gidx = _reduce(gcand, lax.pmin)
+                chosen = jnp.where(jnp.isfinite(gbest), gidx,
+                                   jnp.int32(-1))
+                lw = chosen - base
+                inb = (lw >= 0) & (lw < local_n)
+                sf = jnp.clip(lw, 0, local_n - 1)
+                fq = fq.at[sf].add(
+                    jnp.where(inb, -req[w], 0).astype(fq.dtype))
+                fp = fp.at[sf].add(jnp.where(inb, -1, 0).astype(fp.dtype))
+                unz = unz.at[sf].add(
+                    jnp.where(inb, req_nz[w], 0).astype(unz.dtype))
+                return (fq, fp, unz, out.at[w].set(chosen))
+
+            fq, fp, unz, out = lax.fori_loop(
+                0, W, body, (fq, fp, unz, jnp.full((W,), -1, jnp.int32)))
+            return (fq, fp, unz), out
+
+        return lax.cond(jnp.any(conflict), slow, fast,
+                        (free_q, free_pods, used_nz))
+
+    xs = (req_w, req_nz_w, rows_w, ex_w, real_w)
+    _, out = lax.scan(wave_step, (free_q, free_pods, used_nz), xs)
+    return out.reshape(-1)[:p]
+
+
 def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
                axes: tuple[str, ...] = (NODES_AXIS,),
-               shortlist_k: int = 0):
+               shortlist_k: int = 0, wave_w: int = 0):
     """One solver body for every mesh shape: the node dimension shards over
     `axes` (flattened, first axis major). Reductions run innermost-axis
     first, so a (slice, nodes) pair reduces slice-locally over ICI before
     ONE scalar per slice crosses DCN — the hierarchical argmax of SURVEY
-    §5.7 falls out of the axis order."""
-    key = (mesh, strategy, local_n, axes, shortlist_k)
+    §5.7 falls out of the axis order. wave_w > 1 compiles the wavefront
+    wave-step body instead of the one-pod step (mutually exclusive with
+    shortlist_k; the caller routes)."""
+    key = (mesh, strategy, local_n, axes, shortlist_k, wave_w)
     fn = _SOLVER_CACHE.get(key)
     if fn is not None:
         return fn
@@ -236,6 +387,14 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
             lbest = jnp.max(masked)
             lidx = jnp.min(jnp.where(masked == lbest, iota, local_n))
             return lbest, lidx.astype(jnp.int32)
+
+        if wave_w > 1:
+            return _wave_body(
+                mesh, axes, local_n, base, iota, strategy, wave_w,
+                local_full, _reduce,
+                req_q, req_nz_q, rows, exc, free_q, free_pods, used_nz,
+                alloc_q, mask, static_sc, fit_col_w, bal_col_mask,
+                shape_u, shape_s, w_fit, w_bal)
 
         if shortlist_k:
             # Shard-local prefilter: chunk-start scores over MY columns,
@@ -390,13 +549,16 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
                                      shape_u, shape_s, w_fit, w_bal,
                                      strategy: str, shortlist_k: int = 0,
                                      rows=None, exc=None,
-                                     row_req_q=None, row_req_nz_q=None):
+                                     row_req_q=None, row_req_nz_q=None,
+                                     wave_w: int = 0):
     """Sequential-equivalent greedy over a (slice × nodes) mesh: the same
     solver body as `sharded_greedy_assign`, with the node dimension sharded
     over BOTH axes and the per-step argmax reduced hierarchically —
     slice-local `pmax` over ICI, then ONE scalar per slice across DCN, so
     cross-slice traffic is O(1) per pod regardless of node count (the 50k
-    config #5 enabler). Tie-break matches the single-device solver."""
+    config #5 enabler). Tie-break matches the single-device solver.
+    wave_w as in sharded_greedy_assign (the wave reductions reduce
+    hierarchically through the same axis order)."""
     s_shards = mesh.shape[SLICE_AXIS]
     n_shards = mesh.shape[NODES_AXIS]
     n_total = free_q.shape[0]
@@ -405,7 +567,8 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
     local_n = n_total // shards
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
     run = _solver_fn(mesh, strategy, local_n,
-                     axes=(SLICE_AXIS, NODES_AXIS), shortlist_k=max(k, 0))
+                     axes=(SLICE_AXIS, NODES_AXIS), shortlist_k=max(k, 0),
+                     wave_w=0 if k else max(0, wave_w))
     p = req_q.shape[0]
     if rows is None:
         rows = jnp.arange(p, dtype=jnp.int32)

@@ -140,6 +140,13 @@ class WorkloadResult:
         self.solver_scan_width = 0
         self.solver_shortlist_pods_total = 0
         self.solver_shortlist_fallbacks_total = 0
+        #: Wavefront-solve accounting over the measured phase (r18): the
+        #: wave width of the latest chunk and the speculative-commit vs
+        #: serial-replay split — the replay fraction the AdaptiveTuner's
+        #: width policy keys on is recorded per run, not inferred.
+        self.solver_wave_width = 0
+        self.solver_wave_commits_total = 0
+        self.solver_wave_replays_total = 0
         #: Class-dictionary device-plane accounting over the measured
         #: phase (r14): host-side chunk-prep wall (the prep-vs-solve
         #: split per family), equivalence classes behind the latest
@@ -247,13 +254,25 @@ class WorkloadResult:
                 100.0 * (1.0 - self.solver_shortlist_fallbacks_total
                          / self.solver_shortlist_pods_total), 2)
             if self.solver_shortlist_pods_total else None,
+            "solver_wave_width": self.solver_wave_width,
+            "solver_wave_commits_total": self.solver_wave_commits_total,
+            "solver_wave_replays_total": self.solver_wave_replays_total,
+            "solver_wave_replay_pct": round(
+                100.0 * self.solver_wave_replays_total
+                / (self.solver_wave_commits_total
+                   + self.solver_wave_replays_total), 2)
+            if (self.solver_wave_commits_total
+                + self.solver_wave_replays_total) else None,
             "prep_seconds_total": round(self.prep_seconds_total, 3),
             "plane_classes_per_chunk": self.plane_classes_per_chunk,
             "plane_bytes_uploaded_total": self.plane_bytes_uploaded_total,
             "class_split_fallback_pods": self.class_split_fallback_pods,
             "shard_count": self.shard_count,
             "shard_tensor_rebuilds_total": self.shard_tensor_rebuilds_total,
-            "shard_solve_seconds": round(self.shard_solve_seconds, 3),
+            # 6 decimals: the wavefront solve put small-chunk walls into
+            # the sub-millisecond range, which 3-decimal rounding
+            # reported as a (false) zero.
+            "shard_solve_seconds": round(self.shard_solve_seconds, 6),
             "cross_shard_reductions_total": self.cross_shard_reductions_total,
             "serving_fast_path_pods_total": self.serving_fast_path_pods_total,
             "serving_coalesced_batches_total":
@@ -932,6 +951,8 @@ class PerfRunner:
             metrics.solve_duration.sum(),
             metrics.solver_shortlist_pods.value(),
             metrics.solver_shortlist_fallbacks.value(),
+            metrics.solver_wave_commits.value(),
+            metrics.solver_wave_replays.value(),
             metrics.prep_duration.sum(),
             metrics.plane_bytes.value(),
             metrics.class_split_fallbacks.value(),
@@ -951,7 +972,8 @@ class PerfRunner:
          dispatched_base, checks_base, cache_hits_base, cache_miss_base,
          evals_base, audits_base,
          solve_chunks_base, solve_s_base, sl_pods_base,
-         sl_fall_base, prep_s_base, plane_b_base, class_fb_base,
+         sl_fall_base, wave_com_base, wave_rep_base,
+         prep_s_base, plane_b_base, class_fb_base,
          shard_rb_base, shard_s_base, xshard_base,
          fast_base, coalesced_base, refresh_base, refresh_s_base,
          window_mark) = window
@@ -1003,6 +1025,11 @@ class PerfRunner:
             metrics.solver_shortlist_pods.value() - sl_pods_base)
         result.solver_shortlist_fallbacks_total = int(
             metrics.solver_shortlist_fallbacks.value() - sl_fall_base)
+        result.solver_wave_width = int(metrics.solver_wave_width.value())
+        result.solver_wave_commits_total = int(
+            metrics.solver_wave_commits.value() - wave_com_base)
+        result.solver_wave_replays_total = int(
+            metrics.solver_wave_replays.value() - wave_rep_base)
         result.prep_seconds_total = \
             metrics.prep_duration.sum() - prep_s_base
         result.plane_classes_per_chunk = int(

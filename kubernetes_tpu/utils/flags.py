@@ -95,6 +95,15 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
           "Class-dictionary (C,N) device planes. `0` falls back to "
           "per-pod planes (C == P identity), bit-identical assignments.",
           kill_switch=True),
+    _flag("KTPU_WAVEFRONT", True, _parse_bool,
+          "Speculative wavefront solve (W pods per scan step with exact "
+          "conflict replay). `0` degrades structurally to the "
+          "one-pod-per-step W=1 scans, bit-identical assignments.",
+          kill_switch=True),
+    _flag("KTPU_WAVE_WIDTH", None, _parse_int,
+          "Wavefront width override (pods evaluated per scan step). "
+          "Unset = the AdaptiveTuner policy row picks W and shrinks it "
+          "when the measured replay fraction climbs."),
     _flag("KTPU_WATCH_CACHE", True, _parse_bool,
           "Watch-cache serving tier (store/cacher.py). `0` degrades "
           "every LIST/watch to the direct-mvcc path.", kill_switch=True),

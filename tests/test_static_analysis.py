@@ -236,14 +236,17 @@ class TestFlagRegistry:
         """Every flag: registered, documented, expected default — and
         NAMED here, which is what the FL304 'every flag has a test'
         check greps for: KTPU_SERVING, KTPU_CLASS_PLANES,
-        KTPU_WATCH_CACHE, KTPU_SHARDS, KTPU_SHARD_THRESHOLD,
-        KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH, KTPU_SHORTLIST_K,
-        KTPU_ADMISSION_WINDOW, KTPU_TRACE_THRESHOLD_MS, KTPU_DATA_DIR,
-        KTPU_LOCK_CHECK, KTPU_DEBUG_FREEZE, KTPU_TEST_PLATFORM."""
+        KTPU_WAVEFRONT, KTPU_WAVE_WIDTH, KTPU_WATCH_CACHE, KTPU_SHARDS,
+        KTPU_SHARD_THRESHOLD, KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH,
+        KTPU_SHORTLIST_K, KTPU_ADMISSION_WINDOW,
+        KTPU_TRACE_THRESHOLD_MS, KTPU_DATA_DIR, KTPU_LOCK_CHECK,
+        KTPU_DEBUG_FREEZE, KTPU_TEST_PLATFORM."""
         from kubernetes_tpu.utils import flags
         expected_defaults = {
             "KTPU_SERVING": True,
             "KTPU_CLASS_PLANES": True,
+            "KTPU_WAVEFRONT": True,
+            "KTPU_WAVE_WIDTH": None,
             "KTPU_WATCH_CACHE": True,
             "KTPU_SHARDS": None,
             "KTPU_SHARD_THRESHOLD": 100_000,
@@ -263,7 +266,8 @@ class TestFlagRegistry:
             assert flags.FLAGS[name].doc.strip(), name
         kills = {n for n, f in flags.FLAGS.items() if f.kill_switch}
         assert kills == {"KTPU_SERVING", "KTPU_CLASS_PLANES",
-                         "KTPU_WATCH_CACHE", "KTPU_SHARDS"}
+                         "KTPU_WAVEFRONT", "KTPU_WATCH_CACHE",
+                         "KTPU_SHARDS"}
 
     def test_parse_behaviors(self, monkeypatch):
         from kubernetes_tpu.utils import flags
@@ -443,8 +447,37 @@ class TestTierOneGate:
                      for rel, idx in indices.items()}
         assert entry_map["kubernetes_tpu/ops/solver.py"], \
             "no jit entries found in ops/solver.py"
+        # The r18 wavefront scans are new jit entry points on the
+        # hottest path — discovery must see them as entries...
+        solver_entries = entry_map["kubernetes_tpu/ops/solver.py"]
+        for fn in ("greedy_assign_rescoring_wave",
+                   "multistart_greedy_assign_wave",
+                   "greedy_assign_rescoring_spread_wave",
+                   "greedy_assign_rescoring_shortlist_wave",
+                   "multistart_greedy_assign_shortlist_wave"):
+            assert fn in solver_entries, \
+                f"wavefront entry {fn} not discovered"
         reach = jit_purity._reachable(indices, entry_map)
         rels = {rel for rel, _ in reach}
         assert "kubernetes_tpu/ops/kernels.py" in rels, \
             "call graph no longer reaches the kernels"
+        # ...and the walk must reach the wave-step/replay bodies (new
+        # lax.scan / fori_loop callees nested under the entries) in both
+        # the single-device and the shard_map solvers — an emptied
+        # reachable set here would let host syncs into the wave bodies
+        # pass the gate forever.
+        solver_reach = {qn for rel, qn in reach
+                        if rel == "kubernetes_tpu/ops/solver.py"}
+        for qn in ("_rescoring_wave_scan.wave_step",
+                   "_rescoring_wave_scan.wave_step.slow.body",
+                   "_shortlist_wave_scan.wave_step",
+                   "greedy_assign_rescoring_spread_wave.wave_step",
+                   "_wave_spec_picks", "_wave_conflicts"):
+            assert qn in solver_reach, \
+                f"purity walk no longer reaches {qn}"
+        sharded_reach = {qn for rel, qn in reach
+                         if rel == "kubernetes_tpu/parallel/sharded.py"}
+        assert any(qn.endswith("_wave_body.wave_step")
+                   for qn in sharded_reach), \
+            "purity walk no longer reaches the sharded wave body"
         assert len(reach) >= 20

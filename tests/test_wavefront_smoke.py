@@ -1,0 +1,147 @@
+"""Tier-1 guard for the speculative wavefront solve (small-N, fast).
+
+Pins: (a) the tuner's wave_width policy row — the swept default, the
+KTPU_WAVE_WIDTH override clamp, and the replay-fraction narrowing rule
+with its minimum sample; (b) the wavefront being ACTIVE by default
+through the backend (wave metrics populated, W > 1) with a bounded
+replay fraction on a benign template workload; (c) the KTPU_WAVEFRONT=0
+kill switch degrading STRUCTURALLY (wave counters stay zero — the W=1
+scan functions run, not one-member waves) with identical assignments.
+The heavyweight randomized differential parity lives in
+tests/test_wavefront_solver.py.
+"""
+
+from kubernetes_tpu.ops.backend import AdaptiveTuner
+from kubernetes_tpu.utils import flags
+
+
+class TestWavePolicy:
+    def test_node_count_tiers(self):
+        """The swept policy rows (BASELINE r18, 5k/50k/200k): W grows
+        with node count — structural, like the large-N chunk row."""
+        t = AdaptiveTuner()
+        assert t.wave_width(1024) == AdaptiveTuner.WAVE_WIDTH_SMALL == 32
+        t.n_nodes = 50_000
+        assert t.wave_width(1024) == AdaptiveTuner.WAVE_WIDTH_LARGE == 64
+        t.n_nodes = 200_000
+        assert t.wave_width(1024) == 64
+        # Waves never exceed the chunk (tiny test chunks).
+        assert t.wave_width(4) == 4
+        assert t.wave_width(1) == 1
+
+    def test_override_pins_width(self):
+        t = AdaptiveTuner()
+        with flags.scoped_set("KTPU_WAVE_WIDTH", "2"):
+            assert t.wave_width(1024) == 2
+        with flags.scoped_set("KTPU_WAVE_WIDTH", "4096"):
+            assert t.wave_width(1024) == 1024  # clamped to the chunk
+        with flags.scoped_set("KTPU_WAVE_WIDTH", "0"):
+            assert t.wave_width(1024) == 1
+
+    def test_replay_fraction_narrows_width(self):
+        """>25% replays at a decide() boundary halves W — replays are
+        exact but serial, so a conflicting workload must narrow (the
+        shortlist boost rule, mirrored). The shrink applies across the
+        node-count tiers."""
+        t = AdaptiveTuner()
+        t.n_nodes = 50_000
+        t.observe_wave(512, 512)  # 50% replay fraction
+        t.decide()
+        assert t.wave_width(1024) == 32
+        t.observe_wave(0, 1024)   # still conflicting: halve again
+        t.decide()
+        assert t.wave_width(1024) == 16
+        for _ in range(8):        # shrink floors at the serial scan
+            t.observe_wave(0, 2048)
+            t.decide()
+        assert t.wave_width(1024) == 1
+
+    def test_narrowing_needs_sample_and_rate(self):
+        t = AdaptiveTuner()
+        t.observe_wave(10, 90)    # tiny sample: not trusted yet
+        t.decide()
+        assert t.wave_width(1024) == 32
+        t.observe_wave(900, 124)  # ~12% < 25%: healthy
+        t.decide()
+        assert t.wave_width(1024) == 32
+
+
+class TestBackendSmoke:
+    def _template_pods(self, n):
+        from kubernetes_tpu.api.types import make_pod
+        from kubernetes_tpu.scheduler.types import PodInfo
+        return [PodInfo(make_pod(
+            f"wf-{i}", requests={"cpu": "500m", "memory": "512Mi"},
+            uid=f"wf-uid-{i}")) for i in range(n)]
+
+    def _uniform_cluster(self, n):
+        from kubernetes_tpu.api.types import make_node
+        from kubernetes_tpu.scheduler.cache import SchedulerCache
+        cache = SchedulerCache()
+        for i in range(n):
+            cache.add_node(make_node(
+                f"wn{i}", allocatable={"cpu": "8", "memory": "32Gi",
+                                       "pods": "110"}))
+        return cache.update_snapshot()
+
+    def test_active_by_default_bounded_replays(self):
+        """No flags: the wavefront solves every chunk at the policy W,
+        and the benign template workload keeps the replay fraction under
+        the tuner's own narrowing trigger (beyond it the wavefront would
+        be narrowing itself)."""
+        from test_tpu_backend import default_fwk
+        from kubernetes_tpu.metrics.registry import SchedulerMetrics
+        from kubernetes_tpu.ops.backend import TPUBackend
+        snap = self._uniform_cluster(120)
+        pods = self._template_pods(40)
+        b = TPUBackend(max_batch=16, mesh=None)
+        b.metrics = SchedulerMetrics()
+        assignments, _ = b.assign(pods, snap, default_fwk())
+        m = b.metrics
+        # Small cluster → the small tier, clamped to the test chunk.
+        assert m.solver_wave_width.value() == 16  # min(32, chunk 16)
+        com = m.solver_wave_commits.value()
+        rep = m.solver_wave_replays.value()
+        assert com + rep >= len(pods)
+        assert rep <= AdaptiveTuner.WAVE_REPLAY_RATIO * (com + rep), \
+            (com, rep)
+        assert all(v is not None for v in assignments.values())
+
+    def test_kill_switch_structural_degrade(self):
+        """KTPU_WAVEFRONT=0 routes the W=1 scan FUNCTIONS: wave counters
+        stay zero (no one-member waves in disguise), wave_width reports
+        1, and assignments match the flagless run exactly."""
+        from test_tpu_backend import default_fwk
+        from kubernetes_tpu.metrics.registry import SchedulerMetrics
+        from kubernetes_tpu.ops.backend import TPUBackend
+        snap = self._uniform_cluster(100)
+        pods = self._template_pods(24)
+        fwk = default_fwk()
+        on, _ = TPUBackend(max_batch=16, mesh=None).assign(
+            pods, snap, fwk)
+        b = TPUBackend(max_batch=16, mesh=None)
+        b.metrics = SchedulerMetrics()
+        with flags.scoped_set("KTPU_WAVEFRONT", "0"):
+            off, _ = b.assign(pods, snap, fwk)
+        assert off == on
+        assert b.metrics.solver_wave_commits.value() == 0
+        assert b.metrics.solver_wave_replays.value() == 0
+        assert b.metrics.solver_wave_width.value() == 1
+
+    def test_width_override_through_backend(self):
+        """KTPU_WAVE_WIDTH pins W end to end (the program key carries
+        it) without changing assignments."""
+        from test_tpu_backend import default_fwk
+        from kubernetes_tpu.metrics.registry import SchedulerMetrics
+        from kubernetes_tpu.ops.backend import TPUBackend
+        snap = self._uniform_cluster(80)
+        pods = self._template_pods(16)
+        fwk = default_fwk()
+        base, _ = TPUBackend(max_batch=16, mesh=None).assign(
+            pods, snap, fwk)
+        b = TPUBackend(max_batch=16, mesh=None)
+        b.metrics = SchedulerMetrics()
+        with flags.scoped_set("KTPU_WAVE_WIDTH", "4"):
+            got, _ = b.assign(pods, snap, fwk)
+        assert got == base
+        assert b.metrics.solver_wave_width.value() == 4

@@ -1,0 +1,428 @@
+"""Randomized differential parity for the speculative wavefront solve.
+
+The contract under test: every `*_wave` scan in ops/solver.py produces
+assignments BIT-IDENTICAL to its W=1 counterpart at every wave width —
+tight-capacity conflict storms (speculation must replay, exactly),
+packing strategies whose scores RISE on debit (the non-monotone hazard
+the pairwise re-score exists for), spread constraints with contested
+domains (the structural non-monotonicity rule), the shortlist∩wavefront
+composition, sharded meshes at {1, 4, 8}, and the W ∈ {1, 2, 8, P}
+extremes including W > P. The tier-1 activation/kill-switch/tuner pins
+live in tests/test_wavefront_smoke.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import kernels, solver
+
+WIDTHS = (1, 2, 8)
+
+
+def _problem(rng, n, p, r, tight=False, strategy="LeastAllocated",
+             classes=None):
+    """Random solver arg dict; tight=True makes capacity contested so
+    speculative picks collide with earlier debits (the replay path)."""
+    if tight:
+        alloc_q = rng.integers(2, 6, size=(n, r)).astype(np.int32) * 1000
+        req_q = rng.integers(500, 2500, size=(p, r)).astype(np.int32)
+        free_pods = rng.integers(1, 3, size=(n,)).astype(np.int32)
+    else:
+        alloc_q = rng.integers(20, 60, size=(n, r)).astype(np.int32) * 1000
+        req_q = rng.integers(100, 3000, size=(p, r)).astype(np.int32)
+        free_pods = rng.integers(2, 8, size=(n,)).astype(np.int32)
+    used_q = (alloc_q * rng.uniform(0, 0.5, size=(n, r))).astype(np.int32)
+    if classes:
+        rows = rng.integers(0, classes, size=(p,)).astype(np.int32)
+        # Pods of one class share request rows (the class-key contract).
+        class_req = rng.integers(100, 3000, size=(classes, r)).astype(np.int32)
+        req_q = class_req[rows]
+        mask = rng.random((classes, n)) > 0.15
+        scores = rng.uniform(0, 4, size=(classes, n)).astype(np.float32)
+    else:
+        rows = None
+        mask = rng.random((p, n)) > 0.15
+        scores = rng.uniform(0, 4, size=(p, n)).astype(np.float32)
+    args = dict(
+        req_q=jnp.asarray(req_q), req_nz_q=jnp.asarray(req_q),
+        free_q=jnp.asarray(alloc_q - used_q),
+        free_pods=jnp.asarray(free_pods),
+        used_nz_q=jnp.asarray(used_q), alloc_q=jnp.asarray(alloc_q),
+        mask=jnp.asarray(mask), static_scores=jnp.asarray(scores),
+        fit_col_w=jnp.ones((r,), jnp.float32),
+        bal_col_mask=jnp.ones((r,), np.bool_),
+        shape_u=jnp.asarray([0.0, 100.0], jnp.float32),
+        shape_s=jnp.asarray([0.0, 10.0], jnp.float32),
+        w_fit=jnp.float32(1.0), w_bal=jnp.float32(1.0))
+    if rows is not None:
+        args["rows"] = jnp.asarray(rows)
+    return args, (np.asarray(mask), np.asarray(scores))
+
+
+class TestRescoringWaveParity:
+    @pytest.mark.parametrize("strategy",
+                             ["LeastAllocated", "MostAllocated",
+                              "RequestedToCapacityRatio"])
+    def test_conflict_storm_bit_identity(self, strategy):
+        """Tight capacity + every strategy (incl. the ones whose score
+        RISES on debit): assignments equal the serial scan at every W."""
+        for seed in range(4):
+            rng = np.random.default_rng(seed)
+            args, _ = _problem(rng, n=24, p=31, r=2, tight=True)
+            ref = np.asarray(solver.greedy_assign_rescoring(
+                strategy=strategy, **args))
+            for w in WIDTHS + (31, 64):
+                a, com, rep = solver.greedy_assign_rescoring_wave(
+                    strategy=strategy, wave_w=w, **args)
+                np.testing.assert_array_equal(np.asarray(a), ref,
+                                              err_msg=f"W={w} {strategy}")
+                assert int(com) + int(rep) == 31
+
+    def test_class_planes_and_exceptions(self):
+        """Class-row indirection + pinned-column exceptions ride the
+        wave exactly like the serial scan."""
+        for seed in range(3):
+            rng = np.random.default_rng(100 + seed)
+            args, _ = _problem(rng, n=40, p=26, r=3, classes=4)
+            exc = np.full((26,), -1, np.int32)
+            exc[rng.integers(0, 26, size=5)] = \
+                rng.integers(0, 40, size=5).astype(np.int32)
+            args["exc"] = jnp.asarray(exc)
+            ref = np.asarray(solver.greedy_assign_rescoring(
+                strategy="LeastAllocated", **args))
+            for w in WIDTHS:
+                a, _, _ = solver.greedy_assign_rescoring_wave(
+                    strategy="LeastAllocated", wave_w=w, **args)
+                np.testing.assert_array_equal(np.asarray(a), ref)
+
+    def test_uniform_template_commits_speculatively(self):
+        """The template regime (identical pods, uniform nodes — the
+        bench presets' shape): prefix-distinct speculation must commit
+        without replays, or the wavefront buys nothing where it matters."""
+        n, p, r = 256, 64, 2
+        args = dict(
+            req_q=jnp.asarray(np.full((p, r), 500, np.int32)),
+            req_nz_q=jnp.asarray(np.full((p, r), 500, np.int32)),
+            free_q=jnp.asarray(np.full((n, r), 8000, np.int32)),
+            free_pods=jnp.asarray(np.full((n,), 110, np.int32)),
+            used_nz_q=jnp.asarray(np.zeros((n, r), np.int32)),
+            alloc_q=jnp.asarray(np.full((n, r), 8000, np.int32)),
+            mask=jnp.asarray(np.ones((1, n), np.bool_)),
+            static_scores=jnp.asarray(np.zeros((1, n), np.float32)),
+            fit_col_w=jnp.ones((r,), jnp.float32),
+            bal_col_mask=jnp.ones((r,), np.bool_),
+            shape_u=jnp.zeros((2,), jnp.float32),
+            shape_s=jnp.zeros((2,), jnp.float32),
+            w_fit=jnp.float32(1.0), w_bal=jnp.float32(1.0),
+            rows=jnp.asarray(np.zeros((p,), np.int32)))
+        ref = np.asarray(solver.greedy_assign_rescoring(
+            strategy="LeastAllocated", **args))
+        a, com, rep = solver.greedy_assign_rescoring_wave(
+            strategy="LeastAllocated", wave_w=8, **args)
+        np.testing.assert_array_equal(np.asarray(a), ref)
+        assert int(rep) == 0 and int(com) == p
+
+
+class TestMultistartWaveParity:
+    def _multi_args(self, rng, p, k=4):
+        perms = np.tile(np.arange(p, dtype=np.int32), (k, 1))
+        for i in range(1, k):
+            perms[i] = rng.permutation(p).astype(np.int32)
+        gang = np.zeros((p, 16), np.float32)
+        gr = np.zeros((16,), np.float32)
+        return (jnp.asarray(perms), jnp.asarray(gang), jnp.asarray(gr))
+
+    def test_permuted_orders_and_gangs(self):
+        for seed in range(3):
+            rng = np.random.default_rng(200 + seed)
+            p = 24
+            args, _ = _problem(rng, n=48, p=p, r=2, tight=(seed == 0))
+            perms, gang, gr = self._multi_args(rng, p)
+            # One gang of 5 with an unreachable quota: all-or-nothing
+            # must drop its partial placements identically.
+            gang = np.asarray(gang).copy()
+            gang[:5, 0] = 1.0
+            grq = np.asarray(gr).copy()
+            grq[0] = 5.0
+            ref = np.asarray(solver.multistart_greedy_assign(
+                strategy="LeastAllocated", perms=perms,
+                gang_onehot=jnp.asarray(gang),
+                gang_required=jnp.asarray(grq), **args))
+            for w in WIDTHS:
+                a, com, rep = solver.multistart_greedy_assign_wave(
+                    strategy="LeastAllocated", wave_w=w, perms=perms,
+                    gang_onehot=jnp.asarray(gang),
+                    gang_required=jnp.asarray(grq), **args)
+                np.testing.assert_array_equal(np.asarray(a), ref)
+                # Poisoned chunks rerun the W=1 multistart whole; either
+                # way accounting covers the chunk once.
+                assert int(com) + int(rep) == p
+
+
+class TestShortlistWaveParity:
+    def _shortlist_state(self, args, masks, k, strategy):
+        mask_np, scores_np = masks
+        free_q = np.asarray(args["free_q"])
+        req = np.asarray(args["req_q"])
+        rows = np.asarray(args["rows"]) if "rows" in args \
+            else np.arange(req.shape[0], dtype=np.int32)
+        sc0 = kernels.chunk_start_scores(
+            args["alloc_q"], args["used_nz_q"],
+            jnp.asarray(req), jnp.asarray(scores_np[rows]),
+            args["fit_col_w"], args["bal_col_mask"], args["shape_u"],
+            args["shape_s"], args["w_fit"], args["w_bal"], strategy)
+        feas0 = mask_np[rows] \
+            & np.all(req[:, None, :] <= free_q[None, :, :], axis=-1) \
+            & (np.asarray(args["free_pods"]) >= 1)[None, :]
+        cand, thresh = solver.shortlist_prefilter(
+            jnp.asarray(feas0), sc0, k)
+        hn = jnp.asarray(mask_np[rows].any(axis=1))
+        cls = jnp.arange(req.shape[0], dtype=jnp.int32)
+        return dict(sc0=sc0, sl_class=cls, sl_cand=cand,
+                    sl_thresh=thresh, has_node=hn)
+
+    @pytest.mark.parametrize("strategy",
+                             ["LeastAllocated", "MostAllocated"])
+    def test_shortlist_wave_bit_identity(self, strategy):
+        """shortlist∩wavefront: the pick must clear BOTH the bound check
+        and the pairwise wave check; either failure replays exactly."""
+        for seed in range(4):
+            rng = np.random.default_rng(300 + seed)
+            args, masks = _problem(rng, n=64, p=19, r=2,
+                                   tight=(seed % 2 == 0))
+            sl = self._shortlist_state(args, masks, k=6, strategy=strategy)
+            # sc0 here is per-POD (rows gathered), so the scan's class
+            # index is the identity.
+            ref = np.asarray(solver.greedy_assign_rescoring(
+                strategy=strategy, **args))
+            for w in WIDTHS + (19,):
+                a, nfall, com, rep = \
+                    solver.greedy_assign_rescoring_shortlist_wave(
+                        strategy=strategy, wave_w=w, **sl, **args)
+                np.testing.assert_array_equal(
+                    np.asarray(a), ref, err_msg=f"W={w} {strategy}")
+                assert int(com) + int(rep) == 19
+
+    def test_multistart_shortlist_wave(self):
+        for seed in range(3):
+            rng = np.random.default_rng(400 + seed)
+            p = 16
+            args, masks = _problem(rng, n=96, p=p, r=2)
+            sl = self._shortlist_state(args, masks, k=5,
+                                       strategy="LeastAllocated")
+            perms = np.tile(np.arange(p, dtype=np.int32), (3, 1))
+            for i in range(1, 3):
+                perms[i] = rng.permutation(p).astype(np.int32)
+            gang = jnp.zeros((p, 16), jnp.float32)
+            gr = jnp.zeros((16,), jnp.float32)
+            ref, _ = solver.multistart_greedy_assign_shortlist(
+                strategy="LeastAllocated", perms=jnp.asarray(perms),
+                gang_onehot=gang, gang_required=gr, **sl, **args)
+            for w in WIDTHS:
+                a, _, com, rep = \
+                    solver.multistart_greedy_assign_shortlist_wave(
+                        strategy="LeastAllocated", wave_w=w,
+                        perms=jnp.asarray(perms), gang_onehot=gang,
+                        gang_required=gr, **sl, **args)
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(ref))
+                assert int(com) + int(rep) == p
+
+
+class TestSpreadWaveParity:
+    def _spread_problem(self, rng, n, p, domains, cons):
+        """Contested spread: few domains, tight maxSkew, every pod
+        gating AND contributing — commits open/close domains mid-wave,
+        the structural replay rule's worst case."""
+        r = 2
+        args, _ = _problem(rng, n=n, p=p, r=r)
+        dom = np.zeros((n, domains), np.float32)
+        for i in range(n):
+            dom[i, i % domains] = 1.0
+        cid = np.zeros((domains, cons), np.float32)
+        for d in range(domains):
+            cid[d, d % cons] = 1.0
+        applies = (rng.random((p, cons)) > 0.3).astype(np.float32)
+        contrib = np.maximum(
+            applies, (rng.random((p, cons)) > 0.5)).astype(np.float32)
+        sp = dict(
+            dom_onehot=jnp.asarray(dom), cid_onehot=jnp.asarray(cid),
+            dom_counts=jnp.asarray(
+                rng.integers(0, 3, size=(domains,)).astype(np.float32)),
+            max_skew=jnp.asarray(
+                rng.integers(1, 3, size=(cons,)).astype(np.float32)),
+            min_ok=jnp.ones((cons,), jnp.float32),
+            has_key_nc=jnp.asarray(np.ones((n, cons), np.float32)),
+            applies=jnp.asarray(applies), contributes=jnp.asarray(contrib))
+        return args, sp
+
+    def test_contested_domains_bit_identity(self):
+        for seed in range(4):
+            rng = np.random.default_rng(500 + seed)
+            args, sp = self._spread_problem(rng, n=30, p=21, domains=5,
+                                            cons=2)
+            ref, ref_dc = solver.greedy_assign_rescoring_spread(
+                strategy="LeastAllocated", **sp, **args)
+            for w in WIDTHS + (21,):
+                a, dc, com, rep = solver.greedy_assign_rescoring_spread_wave(
+                    strategy="LeastAllocated", wave_w=w, **sp, **args)
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(ref),
+                                              err_msg=f"W={w}")
+                np.testing.assert_array_equal(np.asarray(dc),
+                                              np.asarray(ref_dc))
+                assert int(com) + int(rep) == 21
+
+    def test_contribute_only_pods_keep_speculating(self):
+        """Pods that CONTRIBUTE to counts but carry no gating constraint
+        (app = 0) must not force replays — only gated members after a
+        count-moving commit replay. Template pods on uniform nodes are
+        the regime where the spread-free wave provably commits 100%
+        (TestRescoringWaveParity.test_uniform_template...), so any
+        replay here would be the structural rule misfiring on app=0."""
+        n, p, r, domains, cons = 40, 16, 2, 4, 2
+        args = dict(
+            req_q=jnp.asarray(np.full((p, r), 500, np.int32)),
+            req_nz_q=jnp.asarray(np.full((p, r), 500, np.int32)),
+            free_q=jnp.asarray(np.full((n, r), 8000, np.int32)),
+            free_pods=jnp.asarray(np.full((n,), 110, np.int32)),
+            used_nz_q=jnp.asarray(np.zeros((n, r), np.int32)),
+            alloc_q=jnp.asarray(np.full((n, r), 8000, np.int32)),
+            mask=jnp.asarray(np.ones((p, n), np.bool_)),
+            static_scores=jnp.asarray(np.zeros((p, n), np.float32)),
+            fit_col_w=jnp.ones((r,), jnp.float32),
+            bal_col_mask=jnp.ones((r,), np.bool_),
+            shape_u=jnp.zeros((2,), jnp.float32),
+            shape_s=jnp.zeros((2,), jnp.float32),
+            w_fit=jnp.float32(1.0), w_bal=jnp.float32(1.0))
+        dom = np.zeros((n, domains), np.float32)
+        for i in range(n):
+            dom[i, i % domains] = 1.0
+        cid = np.zeros((domains, cons), np.float32)
+        for d in range(domains):
+            cid[d, d % cons] = 1.0
+        sp = dict(
+            dom_onehot=jnp.asarray(dom), cid_onehot=jnp.asarray(cid),
+            dom_counts=jnp.asarray(np.zeros((domains,), np.float32)),
+            max_skew=jnp.asarray(np.ones((cons,), np.float32)),
+            min_ok=jnp.ones((cons,), jnp.float32),
+            has_key_nc=jnp.asarray(np.ones((n, cons), np.float32)),
+            applies=jnp.zeros((p, cons), jnp.float32),
+            contributes=jnp.ones((p, cons), jnp.float32))
+        ref, ref_dc = solver.greedy_assign_rescoring_spread(
+            strategy="LeastAllocated", **sp, **args)
+        a, dc, com, rep = solver.greedy_assign_rescoring_spread_wave(
+            strategy="LeastAllocated", wave_w=8, **sp, **args)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(ref))
+        np.testing.assert_array_equal(np.asarray(dc), np.asarray(ref_dc))
+        assert int(rep) == 0 and int(com) == p
+
+
+class TestShardedWaveParity:
+    @pytest.mark.parametrize("shards", [1, 4, 8])
+    def test_mesh_bit_identity(self, shards):
+        from kubernetes_tpu.parallel import build_mesh, \
+            sharded_greedy_assign
+        rng = np.random.default_rng(700 + shards)
+        n, p, r = 64, 18, 2
+        args, _ = _problem(rng, n=n, p=p, r=r)
+        mesh = build_mesh(shards)
+        ref = np.asarray(solver.greedy_assign_rescoring(
+            strategy="LeastAllocated", **args))
+        pos = (args["req_q"], args["req_nz_q"], args["free_q"],
+               args["free_pods"], args["used_nz_q"], args["alloc_q"],
+               args["mask"], args["static_scores"], args["fit_col_w"],
+               args["bal_col_mask"], args["shape_u"], args["shape_s"],
+               args["w_fit"], args["w_bal"])
+        for w in (0, 1, 2, 8):
+            got = np.asarray(sharded_greedy_assign(
+                mesh, *pos, "LeastAllocated", wave_w=w))
+            np.testing.assert_array_equal(got, ref,
+                                          err_msg=f"shards={shards} W={w}")
+
+    def test_mesh_exceptions_global_coords(self):
+        """Pinned columns are GLOBAL node ids; owner-shard translation
+        must keep them exact across shard counts."""
+        from kubernetes_tpu.parallel import build_mesh, \
+            sharded_greedy_assign
+        rng = np.random.default_rng(800)
+        n, p, r = 64, 12, 2
+        args, _ = _problem(rng, n=n, p=p, r=r)
+        exc = np.full((p,), -1, np.int32)
+        exc[[1, 5, 9]] = [60, 3, 33]
+        ref = np.asarray(solver.greedy_assign_rescoring(
+            strategy="LeastAllocated", exc=jnp.asarray(exc), **args))
+        pos = (args["req_q"], args["req_nz_q"], args["free_q"],
+               args["free_pods"], args["used_nz_q"], args["alloc_q"],
+               args["mask"], args["static_scores"], args["fit_col_w"],
+               args["bal_col_mask"], args["shape_u"], args["shape_s"],
+               args["w_fit"], args["w_bal"])
+        for shards in (1, 4, 8):
+            got = np.asarray(sharded_greedy_assign(
+                build_mesh(shards), *pos, "LeastAllocated",
+                exc=jnp.asarray(exc), wave_w=4))
+            np.testing.assert_array_equal(got, ref)
+
+
+class TestBackendE2EParity:
+    def test_backend_wave_vs_kill_switch(self):
+        """End-to-end through TPUBackend: flagless wavefront assignments
+        equal KTPU_WAVEFRONT=0 at W ∈ {1, 4, 8} and the W=chunk extreme
+        (KTPU_WAVE_WIDTH=chunk)."""
+        from test_tpu_backend import default_fwk
+        from kubernetes_tpu.api.types import make_node, make_pod
+        from kubernetes_tpu.ops.backend import TPUBackend
+        from kubernetes_tpu.scheduler.cache import SchedulerCache
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.utils import flags
+
+        rng = np.random.default_rng(11)
+        cache = SchedulerCache()
+        for i in range(60):
+            cache.add_node(make_node(
+                f"n{i}", allocatable={"cpu": str(2 + int(rng.integers(6))),
+                                      "memory": "16Gi", "pods": "16"}))
+        snap = cache.update_snapshot()
+        pods = [PodInfo(make_pod(
+            f"p{i}", requests={"cpu": f"{250 * (1 + int(rng.integers(4)))}m",
+                               "memory": "512Mi"},
+            uid=f"u{i}")) for i in range(70)]
+        fwk = default_fwk()
+        with flags.scoped_set("KTPU_WAVEFRONT", "0"):
+            base, _ = TPUBackend(max_batch=32, mesh=None).assign(
+                pods, snap, fwk)
+        for w in (1, 4, 8, 32):
+            with flags.scoped_set("KTPU_WAVE_WIDTH", str(w)):
+                got, _ = TPUBackend(max_batch=32, mesh=None).assign(
+                    pods, snap, fwk)
+            assert got == base, f"W={w} diverged from kill switch"
+
+    def test_backend_wave_sharded_mesh(self):
+        """Wavefront under the backend's auto-partitioned mesh at shard
+        counts {1, 4, 8}: assignments equal the single-device backend."""
+        from test_tpu_backend import default_fwk
+        from kubernetes_tpu.api.types import make_node, make_pod
+        from kubernetes_tpu.ops.backend import TPUBackend
+        from kubernetes_tpu.parallel import build_mesh
+        from kubernetes_tpu.scheduler.cache import SchedulerCache
+        from kubernetes_tpu.scheduler.types import PodInfo
+
+        cache = SchedulerCache()
+        for i in range(64):
+            cache.add_node(make_node(
+                f"m{i}", allocatable={"cpu": "8", "memory": "32Gi",
+                                      "pods": "110"}))
+        snap = cache.update_snapshot()
+        pods = [PodInfo(make_pod(
+            f"q{i}", requests={"cpu": "500m", "memory": "1Gi"},
+            uid=f"w{i}")) for i in range(48)]
+        fwk = default_fwk()
+        base, _ = TPUBackend(max_batch=16, mesh=None).assign(
+            pods, snap, fwk)
+        for shards in (1, 4, 8):
+            got, _ = TPUBackend(max_batch=16,
+                                mesh=build_mesh(shards)).assign(
+                pods, snap, fwk)
+            assert got == base, f"shards={shards} diverged"
