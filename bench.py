@@ -35,6 +35,22 @@ PRESET_CHURN_RATES = {
     "200k": [250.0, 1000.0, 4000.0],
 }
 
+def _warn_policy_needs_boundary(args, boundary, what: str) -> None:
+    """Shared "refuse to record a lie" guards (drain/churn/serve
+    modes): the policy chain lives on the servers, so --policy-set/
+    --audit-level without the apiserver boundary would measure nothing
+    — and --policy-tenants only shapes a --policy-set, so alone it
+    installs zero policies."""
+    if args.policy_tenants and not args.policy_set:
+        print("warning: --policy-tenants without --policy-set installs "
+              f"NO policies; {what} will measure a policy-free chain",
+              file=sys.stderr)
+    if not boundary and (args.policy_set or args.audit_level):
+        print("warning: --policy-set/--audit-level need "
+              f"--through-apiserver; {what} will evaluate NO policies",
+              file=sys.stderr)
+
+
 PRESETS = {
     #       nodes, warmup pods, measured pods
     "smoke": (100, 200, 1000),
@@ -72,12 +88,7 @@ def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
         print("warning: --profile-dir is not supported in --churn mode "
               "(per-row runs would overwrite each other's traces); no "
               "trace will be written", file=sys.stderr)
-    if not boundary and (args.policy_set or args.audit_level):
-        # Same "refuse to record a lie" guard as drain mode: the policy
-        # chain lives on the servers.
-        print("warning: --policy-set/--audit-level need "
-              "--through-apiserver; churn rows will evaluate NO "
-              "policies", file=sys.stderr)
+    _warn_policy_needs_boundary(args, boundary, "churn rows")
 
     def runner_factory():
         be = None
@@ -87,6 +98,7 @@ def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
         return PerfRunner(backend=be, batch_size=batch if be else 1,
                           through_apiserver=boundary, shards=shards,
                           policy_count=args.policy_set,
+                          policy_tenants=args.policy_tenants,
                           audit_rules=[{"level": args.audit_level}]
                           if args.audit_level else None)
 
@@ -130,12 +142,7 @@ def _run_serve(args, nodes: int, warmup: int, measured: int, shards,
     from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATES
 
     use_tpu = DEFAULT_FEATURE_GATES.enabled("TPUScorer")
-    if not boundary and (args.policy_set or args.audit_level):
-        # Same "refuse to record a lie" guard as the drain/churn modes:
-        # the policy chain lives on the servers.
-        print("warning: --policy-set/--audit-level need "
-              "--through-apiserver; serve rows will evaluate NO "
-              "policies", file=sys.stderr)
+    _warn_policy_needs_boundary(args, boundary, "serve rows")
 
     def make_runner():
         be = None
@@ -145,6 +152,7 @@ def _run_serve(args, nodes: int, warmup: int, measured: int, shards,
         return PerfRunner(backend=be, batch_size=batch if be else 1,
                           through_apiserver=boundary, shards=shards,
                           policy_count=args.policy_set,
+                          policy_tenants=args.policy_tenants,
                           audit_rules=[{"level": args.audit_level}]
                           if args.audit_level else None)
 
@@ -303,6 +311,14 @@ def main(argv=None) -> int:
                          "run — the policy-chain overhead knob "
                          "(BASELINE r9 measures 10 vs 0). Counted in "
                          "the detail JSON's policy_evaluations_total")
+    ap.add_argument("--policy-tenants", type=int, default=0,
+                    help="shard --policy-set across N tenant namespaces "
+                         "(per-namespace selectors, disjoint "
+                         "resourceRules, ~1%% of policies matching any "
+                         "given request — the realistic multi-tenant "
+                         "shape; the 1k-policy headline row uses "
+                         "--policy-set 1000 --policy-tenants 100). "
+                         "0 = the legacy uniform all-matching set")
     ap.add_argument("--audit-level", default="",
                     choices=["", "Metadata", "Request",
                              "RequestResponse"],
@@ -424,16 +440,12 @@ def main(argv=None) -> int:
     if args.serve:
         return _run_serve(args, nodes, warmup, measured, shards, boundary,
                           batch)
-    if not args.through_apiserver and (args.policy_set or args.audit_level):
-        # The policy chain lives on the servers: without the boundary
-        # these flags measure nothing — refuse to record a lie.
-        print("warning: --policy-set/--audit-level need "
-              "--through-apiserver; the run will evaluate NO policies",
-              file=sys.stderr)
+    _warn_policy_needs_boundary(args, boundary, "the run")
     runner = PerfRunner(backend=backend, batch_size=batch,
                         through_apiserver=boundary,
                         profile_dir=args.profile_dir or None,
                         policy_count=args.policy_set,
+                        policy_tenants=args.policy_tenants,
                         audit_rules=[{"level": args.audit_level}]
                         if args.audit_level else None,
                         shards=shards)

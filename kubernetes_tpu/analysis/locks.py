@@ -31,6 +31,13 @@ queues are what this pass audits:
   fetch workers `inc()`ed — "dictionary changed size during iteration"
   on the serving seam. Applies to THREADING locks only; asyncio
   conditions serialize on the loop and don't need read-side locking.
+- **LK206 file I/O under a lock**: `open()` / `os.rename` / `os.replace`
+  / `os.remove` / `os.unlink` while holding any lock. Added for the
+  audit sink workers (ISSUE 15): the rotation sink's segment shuffle and
+  batch append are disk I/O — milliseconds on a loaded box — and a lock
+  held across them stalls every emitter. The runtime twin is the
+  `check_dispatch_seam` guard in `policy/audit.py`'s `_write_batch` /
+  webhook `_send`.
 
 Lock identity is the attribute site (`module.Class.attr`); anything
 assigned from `threading.Lock/RLock/Condition`, `asyncio.Lock/
@@ -65,6 +72,8 @@ _FETCH_CALLS = ("np.asarray", "numpy.asarray", "np.array",
                 "jax.device_get")
 _SEND_ATTRS = ("sendall", "send_bytes", "drain")
 _SEND_CALLS = ("self.transport.write", "transport.write")
+_FILE_CALLS = ("open", "os.rename", "os.replace", "os.remove",
+               "os.unlink")
 
 
 def _lockish_attr(name: str) -> bool:
@@ -245,6 +254,15 @@ def _scan_body(mod, modbase, qn, body, held, cls_locks, findings,
         if isinstance(node, (ast.With, ast.AsyncWith)):
             acquired = []
             for item in node.items:
+                if held or acquired:
+                    # `with open(...)`-style context expressions execute
+                    # while the outer locks are held — hazard-check them
+                    # (the rotation sink's file-I/O shape, LK206).
+                    # `acquired` covers the one-statement form
+                    # `with self._lock, open(...)`: items to the left
+                    # are already held when this item's expr runs.
+                    _check_held(mod, qn, item.context_expr,
+                                held + acquired, cls_locks, findings)
                 attr = _with_lock_attr(item)
                 if attr is not None:
                     for outer_attr, outer_id, _a in held:
@@ -322,6 +340,14 @@ def _check_held(mod, qn, node, held, cls_locks, findings):
                     symbol=f"{qn}:{n or sub.func.attr}",
                     message=f"`{qn}` sends on a wire while holding "
                             f"{held_names}"))
+            elif n in _FILE_CALLS:
+                findings.append(Finding(
+                    pass_id=PASS_ID, code="LK206", path=mod.rel,
+                    line=sub.lineno, symbol=f"{qn}:{n}",
+                    message=f"`{qn}` performs file I/O while holding "
+                            f"{held_names} — disk latency stalls every "
+                            "other holder (rotate/append outside the "
+                            "lock)"))
 
 
 def _written_attrs(body) -> set[str]:

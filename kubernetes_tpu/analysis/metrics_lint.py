@@ -3,10 +3,13 @@
 The metric names are a dashboard contract (README Observability table;
 SURVEY §5.5 pins the scheduler family to the reference's names), so
 convention violations are API bugs, not style. The pass reads every
-registration in `metrics/registry.py` — `r.counter(...)` /
-`r.gauge(...)` / `r.histogram(...)` and direct `Counter(...)` /
-`Gauge(...)` / `Histogram(...)` constructions with a literal name —
-and enforces:
+registration in the WHOLE tree — `r.counter(...)` / `r.gauge(...)` /
+`r.histogram(...)` and direct `Counter(...)` / `Gauge(...)` /
+`Histogram(...)` constructions with a literal name. (Originally it
+only read `metrics/registry.py`; the audit sinks register their own
+counters in `policy/audit.py` and the policy engine in `policy/vap.py`,
+so ISSUE 15 widened the scan — a counter is a counter wherever it is
+constructed.) It enforces:
 
 - MT401 invalid metric name (Prometheus `[a-zA-Z_:][a-zA-Z0-9_:]*`).
 - MT402 counter without the `_total` suffix.
@@ -32,6 +35,8 @@ from kubernetes_tpu.analysis.engine import Finding, Module, call_name
 
 PASS_ID = "metrics-lint"
 
+#: kept for the fixture tests' narrow-scan mode; the default run scans
+#: every module (registrations live in policy/audit.py etc. too).
 REGISTRY_SUFFIX = "metrics/registry.py"
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -88,10 +93,11 @@ def _registrations(mod: Module):
 
 
 def run(modules: list[Module],
-        registry_suffix: str = REGISTRY_SUFFIX) -> list[Finding]:
+        registry_suffix: str | None = None) -> list[Finding]:
     findings: list[Finding] = []
     for mod in modules:
-        if not mod.rel.endswith(registry_suffix):
+        if registry_suffix is not None \
+                and not mod.rel.endswith(registry_suffix):
             continue
         for kind, name, labels, line in _registrations(mod):
             def emit(code, msg, anchor=None):

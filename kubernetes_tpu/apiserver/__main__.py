@@ -40,9 +40,56 @@ def build_parser() -> argparse.ArgumentParser:
                     help="YAML manifest of ClusterRole/ClusterRoleBinding "
                          "objects enabling RBAC authz")
     ap.add_argument("--audit-log", action="store_true")
+    ap.add_argument("--audit-policy", default=None,
+                    help="audit.k8s.io/v1 Policy YAML enabling the "
+                         "stage-event audit pipeline (levels None/"
+                         "Metadata/Request/RequestResponse, first "
+                         "matching rule wins)")
+    ap.add_argument("--audit-log-path", default=None,
+                    help="JSON-lines audit sink with size/age rotation "
+                         "(the reference's --audit-log-path)")
+    ap.add_argument("--audit-log-maxsize-mb", type=int, default=10,
+                    help="rotate the audit log past this size")
+    ap.add_argument("--audit-log-maxage-s", type=float, default=None,
+                    help="rotate the audit log past this segment age "
+                         "in seconds (default: size-only rotation)")
+    ap.add_argument("--audit-log-maxbackups", type=int, default=5,
+                    help="rotated audit segments kept (.1 newest)")
+    ap.add_argument("--audit-webhook-config", default=None,
+                    help="YAML {url, batch: {maxSize}, retry: "
+                         "{backoff, maxAttempts}} enabling the batching "
+                         "audit webhook sink (the reference's "
+                         "--audit-webhook-config)")
     ap.add_argument("--trace", action="store_true",
                     help="enable OTel-style request spans")
     return ap
+
+
+def build_audit_pipeline(args):
+    """AuditPipeline from the CLI options, or None when no audit policy
+    / sink was asked for. Sink precedence: webhook config > rotated
+    file > in-memory (policy with no sink still collects in memory)."""
+    if not (args.audit_policy or args.audit_log_path
+            or args.audit_webhook_config):
+        return None
+    from kubernetes_tpu.policy.audit import (
+        AuditPipeline,
+        AuditPolicy,
+        RotatingFileSink,
+        WebhookSink,
+    )
+    policy = AuditPolicy.from_file(args.audit_policy) \
+        if args.audit_policy else AuditPolicy.metadata_for_all()
+    sink = None
+    if args.audit_webhook_config:
+        sink = WebhookSink.from_config(args.audit_webhook_config)
+    elif args.audit_log_path:
+        sink = RotatingFileSink(
+            args.audit_log_path,
+            max_bytes=args.audit_log_maxsize_mb * 2 ** 20,
+            max_age_s=args.audit_log_maxage_s,
+            backups=args.audit_log_maxbackups)
+    return AuditPipeline(policy, sink=sink)
 
 
 async def serve(args) -> None:
@@ -86,6 +133,7 @@ async def serve(args) -> None:
     api = APIServer(store, host=args.host, port=args.port,
                     bearer_tokens=tokens, authorizer=authorizer,
                     audit_log=args.audit_log,
+                    audit=build_audit_pipeline(args),
                     data_dir=args.data_dir, fsync=args.fsync)
     store = api.store
     await api.start()

@@ -161,18 +161,27 @@ class WebhookAdmission:
                         logger.warning(
                             "ignoring invalid patch from webhook %s: %s",
                             wh.get("name"), e)
-        if self.policy_engine is not None and operation != "delete":
-            # Expression policies see the POST-mutation object; the
-            # stored current object rides as oldObject on updates (the
-            # reference passes the existing object from storage).
-            old = None
-            if operation == "update":
-                from kubernetes_tpu.api.meta import namespaced_name
-                old = self.store._table(resource).get(
-                    namespaced_name(obj))
-            self.policy_engine.validate(
-                obj, resource, operation, old_object=old,
-                user=user, groups=groups)
+        if self.policy_engine is not None:
+            if operation == "delete":
+                # DELETE: the reference evaluates expressions with
+                # `object=null` and the stored object as oldObject —
+                # both wires hand the current object in as `obj` here.
+                self.policy_engine.validate(
+                    None, resource, operation, old_object=obj,
+                    user=user, groups=groups)
+            else:
+                # Expression policies see the POST-mutation object; the
+                # stored current object rides as oldObject on updates
+                # (the reference passes the existing object from
+                # storage).
+                old = None
+                if operation == "update":
+                    from kubernetes_tpu.api.meta import namespaced_name
+                    old = self.store._table(resource).get(
+                        namespaced_name(obj))
+                self.policy_engine.validate(
+                    obj, resource, operation, old_object=old,
+                    user=user, groups=groups)
         for cfg in self._configs("validatingwebhookconfigurations"):
             for wh in cfg.get("webhooks") or []:
                 if not _rules_match(wh, resource, operation):

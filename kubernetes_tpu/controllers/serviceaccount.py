@@ -83,6 +83,17 @@ class TokenController(Controller):
     WORKERS = 2
     RESYNC_PERIOD = 5.0
 
+    def _events(self):
+        # Lazy: the recorder spins a drain task on first use, and most
+        # syncs never emit (ADVICE r5 — only the double-squat dead end
+        # needs the Event surface).
+        rec = getattr(self, "_recorder", None)
+        if rec is None:
+            from kubernetes_tpu.client.events import EventRecorder
+            rec = self._recorder = EventRecorder(
+                self.store, "serviceaccount-token-controller")
+        return rec
+
     def setup(self, factory: InformerFactory) -> None:
         self.sa_informer = factory.informer("serviceaccounts")
         self.secret_informer = factory.informer("secrets")
@@ -171,6 +182,22 @@ class TokenController(Controller):
                     secret_name = candidate
                     break
         if secret_name is None:
+            # BOTH candidate names are squatted by foreign secrets
+            # (wrong type/annotation): every resync from here recomputes
+            # the same names and dead-ends identically, so the SA never
+            # gets a working token. Returning silently hid that (ADVICE
+            # r5) — log once per sync and emit a Warning Event so the
+            # dead-end is observable from `kubectl describe sa` land.
+            logger.warning(
+                "serviceaccount %s: token secret names %r are both "
+                "held by foreign secrets; no token will be issued "
+                "until one is freed", key,
+                [f"{sa_name}-token", f"{sa_name}-token-{suffix}"])
+            self._events().event(
+                sa, "Warning", "TokenSecretSquatted",
+                f"cannot issue a token secret: both candidate names "
+                f"{sa_name}-token and {sa_name}-token-{suffix} exist "
+                f"with a foreign type or owner annotation")
             return
 
         # Mirror the secret name into the SA (kubectl describe parity).

@@ -25,25 +25,58 @@ pre-impersonation identity) and ResponseComplete after, carrying the
 response status plus `impersonatedUser` when the impersonation filter
 swapped identities mid-chain.
 
-The sink is a bounded async JSON-lines writer (the reference's buffered
-backend): `emit` never blocks the serving path; overflow drops (counted,
+Sinks are bounded and async (the reference's buffered backend): `emit`
+never blocks the serving path; overflow drops (counted,
 `audit_events_dropped_total`) rather than backpressuring — the same
-DropIfChannelFull stance as client/events.py.
+DropIfChannelFull stance as client/events.py. Production backends
+(SURVEY §5.5):
+
+- `RotatingFileSink` — the `--audit-log-path` analog with
+  `--audit-log-maxsize` / `--audit-log-maxage` / `--audit-log-maxbackups`
+  rotation (size OR age triggers; `audit.log.1` is the newest backup).
+- `WebhookSink` — the `--audit-webhook-config` analog: batches events
+  into one `EventList` POST, bounded queue, exponential-backoff retry;
+  exhausted retries drop (counted), never backpressure.
+
+Both ride the same emit/close seam `AuditPipeline` already uses, and
+both guard their I/O with `locking.check_dispatch_seam` — the runtime
+twin of ktpu-lint's LK206 (no lock held across file I/O or wire sends).
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import itertools
 import json
 import logging
+import os
 import time
 from typing import Any, Mapping
 
 from kubernetes_tpu.metrics.registry import Registry
 from kubernetes_tpu.utils import tracing
+from kubernetes_tpu.utils.locking import check_dispatch_seam
 
 logger = logging.getLogger(__name__)
+
+#: the request's open audit context (set by AuditPipeline.begin, cleared
+#: at response_complete): the seam through which the admission chain —
+#: notably VAP auditAnnotations (policy/vap.py) — attaches annotations
+#: to the event without threading the context through every handler.
+#: contextvars give per-task isolation, so concurrent requests on one
+#: loop cannot cross-annotate.
+_CURRENT_CTX: contextvars.ContextVar[dict | None] = \
+    contextvars.ContextVar("ktpu_audit_ctx", default=None)
+
+
+def annotate(key: str, value: str) -> None:
+    """Attach `annotations[key] = value` to the current request's audit
+    event (no-op when the request isn't audited). First writer wins per
+    key, mirroring the reference's audit.AddAuditAnnotation."""
+    ctx = _CURRENT_CTX.get()
+    if ctx is not None:
+        ctx.setdefault("annotations", {}).setdefault(key, value)
 
 LEVEL_NONE = "None"
 LEVEL_METADATA = "Metadata"
@@ -187,6 +220,27 @@ class AuditSink:
         if len(self.entries) > self.MAX_ENTRIES:
             del self.entries[:len(self.entries) - self.MAX_ENTRIES]
 
+    def _write_batch(self, batch: list[dict]) -> None:
+        """One buffered append per batch; the event loop eats a short
+        write rather than a thread handoff per line. The rotation
+        subclass hooks _before_append/_after_append — serialization and
+        the dispatch-seam guard (the LK206 runtime twin) live HERE
+        only. Never called with a lock held."""
+        check_dispatch_seam("audit.file_write")
+        lines = "".join(
+            json.dumps(e, separators=(",", ":")) + "\n" for e in batch)
+        self._before_append(len(lines))
+        with open(self.path, "a") as f:
+            f.write(lines)
+        self._after_append(len(lines))
+
+    def _before_append(self, nbytes: int) -> None:
+        """Hook: called with the serialized batch size before the
+        append (RotatingFileSink rotates here)."""
+
+    def _after_append(self, nbytes: int) -> None:
+        """Hook: called after a successful append."""
+
     async def _drain(self) -> None:
         try:
             while self._pending:
@@ -195,13 +249,7 @@ class AuditSink:
                     self._absorb(batch)
                     continue
                 try:
-                    lines = "".join(
-                        json.dumps(e, separators=(",", ":")) + "\n"
-                        for e in batch)
-                    # One buffered append per batch; the event loop eats
-                    # a short write rather than a thread handoff per line.
-                    with open(self.path, "a") as f:
-                        f.write(lines)
+                    self._write_batch(batch)
                 except OSError:
                     logger.exception("audit sink write failed "
                                      "(%d events lost)", len(batch))
@@ -227,14 +275,243 @@ class AuditSink:
                 self._absorb(batch)
             else:
                 try:
-                    with open(self.path, "a") as f:
-                        f.write("".join(
-                            json.dumps(e, separators=(",", ":")) + "\n"
-                            for e in batch))
+                    self._write_batch(batch)
                 except OSError:
                     logger.exception("audit sink close lost %d events",
                                      len(batch))
                     self.events_dropped.inc(len(batch))
+
+
+class RotatingFileSink(AuditSink):
+    """Size/age-rotated JSON-lines file sink — the reference's
+    `--audit-log-path` + `--audit-log-maxsize`/`--audit-log-maxage`/
+    `--audit-log-maxbackups` backend.
+
+    Rotation happens at batch-write time (before the append that would
+    cross the size bound, or once the open segment outlives max_age_s):
+    `path` renames to `path.1`, existing backups shift up, anything past
+    `backups` is deleted. Writes stay on the event loop like the base
+    sink — one short buffered append per batch, no locks held (the
+    dispatch-seam guard in `_write_batch` enforces it under
+    KTPU_LOCK_CHECK)."""
+
+    def __init__(self, path: str, *, max_bytes: int = 10 * 2 ** 20,
+                 max_age_s: float | None = None, backups: int = 5,
+                 registry: Registry | None = None):
+        super().__init__(path=path, registry=registry)
+        self.max_bytes = max(1, int(max_bytes))
+        self.max_age_s = max_age_s
+        self.backups = max(0, int(backups))
+        self.rotations = self.registry.counter(
+            "audit_log_rotations_total",
+            "Audit log file rotations (size or age trigger)")
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+        self._opened_at = time.monotonic()
+
+    def register_into(self, registry: Registry) -> None:
+        super().register_into(registry)
+        registry._metrics.setdefault(self.rotations.name, self.rotations)
+
+    def _should_rotate(self, incoming: int) -> bool:
+        if self._size and self._size + incoming > self.max_bytes:
+            return True
+        return (self.max_age_s is not None and self._size
+                and time.monotonic() - self._opened_at >= self.max_age_s)
+
+    def _rotate(self) -> None:
+        if self.backups == 0:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+        else:
+            for i in range(self.backups - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            try:
+                os.replace(self.path, f"{self.path}.1")
+            except OSError:
+                pass
+        self._size = 0
+        self._opened_at = time.monotonic()
+        self.rotations.inc()
+
+    def _before_append(self, nbytes: int) -> None:
+        if self._should_rotate(nbytes):
+            self._rotate()
+
+    def _after_append(self, nbytes: int) -> None:
+        self._size += nbytes
+
+
+class WebhookSink:
+    """Batching audit webhook — the reference's `--audit-webhook-config`
+    backend: events buffer into a bounded queue and a loop-resident
+    worker POSTs them as one `audit.k8s.io/v1 EventList` per batch, with
+    exponential-backoff retry. A batch that exhausts its retries drops
+    (counted) — the pipeline never backpressures the serving path, and
+    never blocks a second batch behind a dead endpoint forever.
+
+    Duck-compatible with AuditSink where AuditPipeline cares (emit /
+    close / register_into / events_total / events_dropped). `post` is
+    the transport seam — default aiohttp POST of the config's `url`;
+    tests inject a local server or a callable."""
+
+    MAX_PENDING = 4096
+
+    def __init__(self, url: str, *, batch_max: int = 400,
+                 initial_backoff: float = 0.25, max_retries: int = 4,
+                 timeout: float = 10.0,
+                 registry: Registry | None = None, post=None):
+        self.url = url
+        self.batch_max = max(1, int(batch_max))
+        self.initial_backoff = initial_backoff
+        self.max_retries = max(0, int(max_retries))
+        self.timeout = timeout
+        r = registry or Registry()
+        self.registry = r
+        self.events_total = r.counter(
+            "audit_events_total", "Audit stage events emitted",
+            labels=("stage",))
+        self.events_dropped = r.counter(
+            "audit_events_dropped_total",
+            "Audit events dropped on sink overflow")
+        self.webhook_batches = r.counter(
+            "audit_webhook_batches_total",
+            "Audit webhook batch deliveries attempted",
+            labels=("outcome",))
+        self.webhook_retries = r.counter(
+            "audit_webhook_retries_total",
+            "Audit webhook batch retry attempts after a failed POST")
+        self._post = post
+        self._session = None
+        self._pending: list[dict] = []
+        self._draining = False
+        self._drain_task: asyncio.Task | None = None
+        self._closed = False
+
+    @classmethod
+    def from_config(cls, path: str,
+                    registry: Registry | None = None) -> "WebhookSink":
+        """Build from a YAML config file:
+
+            url: http://collector:9099/audit
+            batch: {maxSize: 400}
+            retry: {backoff: 0.25, maxAttempts: 4}
+        """
+        import yaml
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        url = doc.get("url")
+        if not url:
+            raise ValueError(f"audit webhook config {path}: url required")
+        batch = doc.get("batch") or {}
+        retry = doc.get("retry") or {}
+        return cls(url, batch_max=batch.get("maxSize", 400),
+                   initial_backoff=retry.get("backoff", 0.25),
+                   max_retries=retry.get("maxAttempts", 4),
+                   registry=registry)
+
+    def register_into(self, registry: Registry) -> None:
+        for c in (self.events_total, self.events_dropped,
+                  self.webhook_batches, self.webhook_retries):
+            registry._metrics.setdefault(c.name, c)
+
+    def emit(self, entry: dict) -> None:
+        """Fire-and-forget enqueue; never blocks the handler chain."""
+        if self._closed:
+            return
+        if len(self._pending) >= self.MAX_PENDING:
+            self.events_dropped.inc()
+            return
+        self.events_total.inc(stage=entry.get("stage", ""))
+        self._pending.append(entry)
+        self._kick()
+
+    def _kick(self) -> None:
+        if self._draining or not self._pending:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop: events wait for close()'s final flush
+        self._draining = True
+        self._drain_task = asyncio.ensure_future(self._drain())
+
+    async def _send(self, batch: list[dict]) -> None:
+        """One EventList POST. The dispatch-seam guard is the runtime
+        twin of LK206 — the worker must not hold a lock across the
+        wire send."""
+        check_dispatch_seam("audit.webhook_send")
+        body = {"kind": "EventList", "apiVersion": "audit.k8s.io/v1",
+                "items": batch}
+        if self._post is not None:
+            await self._post(self.url, body)
+            return
+        import aiohttp
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout))
+        async with self._session.post(self.url, json=body) as resp:
+            resp.raise_for_status()
+
+    async def _deliver(self, batch: list[dict]) -> None:
+        backoff = self.initial_backoff
+        for attempt in range(self.max_retries + 1):
+            try:
+                await self._send(batch)
+                self.webhook_batches.inc(outcome="ok")
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if attempt == self.max_retries:
+                    self.webhook_batches.inc(outcome="failed")
+                    self.events_dropped.inc(len(batch))
+                    logger.warning(
+                        "audit webhook %s: batch of %d dropped after "
+                        "%d attempts: %s", self.url, len(batch),
+                        attempt + 1, e)
+                    return
+                self.webhook_retries.inc()
+                await asyncio.sleep(backoff)
+                backoff *= 2
+
+    async def _drain(self) -> None:
+        try:
+            while self._pending:
+                batch = self._pending[:self.batch_max]
+                del self._pending[:self.batch_max]
+                await self._deliver(batch)
+        finally:
+            self._draining = False
+
+    async def close(self) -> None:
+        """Flush the queue (retries included), then refuse new events
+        and close the transport. AWAITS the in-flight drain task rather
+        than racing it: stealing its batches while it sleeps in a retry
+        backoff would let it wake after the session is closed and mint
+        a fresh one nothing ever closes."""
+        self._closed = True
+        task = self._drain_task
+        if task is not None and not task.done():
+            try:
+                await task
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # _deliver already counted the loss
+                logger.exception("audit webhook drain failed in close")
+        while self._pending:
+            batch = self._pending[:self.batch_max]
+            del self._pending[:self.batch_max]
+            await self._deliver(batch)
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
 
 
 class AuditPipeline:
@@ -269,6 +546,10 @@ class AuditPipeline:
                                         namespace=namespace)
         level = rule.get("level", LEVEL_NONE) if rule else LEVEL_NONE
         if level == LEVEL_NONE:
+            # Clear the annotation seam: on a long-lived wire task a
+            # stale context from the PREVIOUS op must not collect this
+            # request's annotations.
+            _CURRENT_CTX.set(None)
             return None
         omit = set((rule or {}).get("omitStages") or ())
         ctx = {
@@ -298,6 +579,11 @@ class AuditPipeline:
             self.sink.emit({**ctx, "stage": STAGE_REQUEST_RECEIVED,
                             "stageTimestamp": _now()})
         ctx["_omit"] = omit
+        # Open the annotation seam: chain stages running under this
+        # request (VAP auditAnnotations, webhooks) attach to this event
+        # via annotate() — annotations land on ResponseComplete, the
+        # stage emitted after they are set.
+        _CURRENT_CTX.set(ctx)
         return ctx
 
     def response_complete(self, ctx: dict | None, *, code: int,
@@ -310,6 +596,8 @@ class AuditPipeline:
         is who the request ran as."""
         if ctx is None:
             return
+        if _CURRENT_CTX.get() is ctx:
+            _CURRENT_CTX.set(None)
         omit = ctx.pop("_omit", set())
         if STAGE_RESPONSE_COMPLETE in omit:
             return

@@ -46,7 +46,11 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Any, Mapping
+# collections.abc, not typing: the runtime isinstance checks in the
+# budget helpers are the hottest lines of the admission chain, and
+# typing.Mapping's __instancecheck__ costs ~20x the abc-cached check.
+from collections.abc import Mapping
+from typing import Any
 
 #: default per-evaluation step budget (the reference's runtime cost
 #: limit analog). A typical policy expression uses < 100 steps.
@@ -238,11 +242,13 @@ _BUDGET_MSG = "expression cost budget exceeded"
 
 def _get(base: Any, attr: str, b: list) -> Any:
     # budget tick inlined (this is the hottest helper: one call per
-    # field access, ~10 policy evaluations per admitted request)
+    # field access, ~10 policy evaluations per admitted request); the
+    # `type is dict` check short-circuits the abc isinstance — nearly
+    # every value here is a plain JSON dict.
     b[0] -= 1
     if b[0] < 0:
         raise BudgetExceeded(_BUDGET_MSG)
-    if not isinstance(base, Mapping):
+    if type(base) is not dict and not isinstance(base, Mapping):
         raise ExpressionError(
             f"field access {attr!r} on non-object "
             f"{type(base).__name__}")
@@ -255,7 +261,8 @@ def _get_t(base: Any, attr: str, b: list) -> Any:
     b[0] -= 1
     if b[0] < 0:
         raise BudgetExceeded(_BUDGET_MSG)
-    if base is _MISSING or not isinstance(base, Mapping):
+    if type(base) is not dict and (
+            base is _MISSING or not isinstance(base, Mapping)):
         return _MISSING
     return base[attr] if attr in base else _MISSING
 
@@ -264,7 +271,7 @@ def _idx(base: Any, idx: Any, b: list) -> Any:
     b[0] -= 1
     if b[0] < 0:
         raise BudgetExceeded(_BUDGET_MSG)
-    if isinstance(base, Mapping):
+    if type(base) is dict or isinstance(base, Mapping):
         if idx in base:
             return base[idx]
         raise ExpressionError(f"no such key {idx!r}")
@@ -474,6 +481,23 @@ class CompiledExpression:
         Everything lives in the GLOBALS dict (not locals) so names
         resolve inside comprehension frames too."""
         env["_b"] = [budget]
+        try:
+            return eval(self._code, env)  # noqa: S307 — sandboxed code
+        except ExpressionError:
+            raise
+        except NameError as e:
+            raise ExpressionError(f"unknown variable: {e}") from None
+        except (TypeError, ValueError, KeyError, IndexError,
+                ZeroDivisionError, AttributeError, OverflowError,
+                RecursionError) as e:
+            raise ExpressionError(f"evaluation failed: {e}") from None
+
+    def evaluate_shared(self, env: dict) -> Any:
+        """Evaluate inside an ALREADY-BUDGETED env (no fresh budget
+        installed): the `variables.<name>` composition path, where a
+        lazily-evaluated variable must tick the enclosing expression's
+        budget instead of minting its own — a chain of variables cannot
+        multiply the per-expression cost limit."""
         try:
             return eval(self._code, env)  # noqa: S307 — sandboxed code
         except ExpressionError:

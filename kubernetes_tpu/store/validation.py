@@ -114,12 +114,35 @@ def validate_admission_policy(policy: dict) -> None:
         ExpressionError,
         compile_expression,
     )
-    for i, v in enumerate(validations):
+
+    def check(source: str, where: str) -> None:
         try:
-            compile_expression(v.get("expression", ""))
+            compile_expression(source)
         except ExpressionError as e:
+            raise Invalid(
+                f"ValidatingAdmissionPolicy: {where}: {e}") from e
+
+    for i, v in enumerate(validations):
+        check(v.get("expression", ""), f"spec.validations[{i}]")
+        if v.get("messageExpression"):
+            check(v["messageExpression"],
+                  f"spec.validations[{i}].messageExpression")
+    for i, c in enumerate(spec.get("matchConditions") or []):
+        if not c.get("name"):
             raise Invalid(f"ValidatingAdmissionPolicy: "
-                          f"spec.validations[{i}]: {e}") from e
+                          f"spec.matchConditions[{i}].name is required")
+        check(c.get("expression", ""), f"spec.matchConditions[{i}]")
+    for i, var in enumerate(spec.get("variables") or []):
+        if not var.get("name"):
+            raise Invalid(f"ValidatingAdmissionPolicy: "
+                          f"spec.variables[{i}].name is required")
+        check(var.get("expression", ""), f"spec.variables[{i}]")
+    for i, a in enumerate(spec.get("auditAnnotations") or []):
+        if not a.get("key"):
+            raise Invalid(f"ValidatingAdmissionPolicy: "
+                          f"spec.auditAnnotations[{i}].key is required")
+        check(a.get("valueExpression", ""),
+              f"spec.auditAnnotations[{i}].valueExpression")
 
 
 def validate_vap_binding(binding: dict) -> None:

@@ -107,6 +107,12 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
     _flag("KTPU_WATCH_CACHE", True, _parse_bool,
           "Watch-cache serving tier (store/cacher.py). `0` degrades "
           "every LIST/watch to the direct-mvcc path.", kill_switch=True),
+    _flag("KTPU_POLICY_INDEX", True, _parse_bool,
+          "Pre-indexed ValidatingAdmissionPolicy matching (policy/"
+          "vap.py): exact (resource, operation) reverse maps + interned "
+          "namespace-selector signatures make admission O(matching "
+          "policies). `0` degrades structurally to the linear "
+          "all-policies scan, bit-identical verdicts.", kill_switch=True),
     _flag("KTPU_SHARDS", None, _parse_int,
           "Control-plane shard count override; `1` is the kill switch "
           "(plain single MVCCStore). Unset = the node-count threshold "

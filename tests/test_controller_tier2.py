@@ -363,3 +363,64 @@ class TestTokenSquattedName(unittest.TestCase):
                                              "default/robot-token")
                 self.assertEqual(squatted.get("type"), "Opaque")
         run(body())
+
+    def test_double_squat_warns_and_emits_event(self):
+        """BOTH candidate names squatted by foreign secrets (ADVICE
+        r5): sync must not return silently — it logs a warning and
+        emits a Warning Event on the SA so the dead-end is observable —
+        and it never mirrors a dead name into sa.secrets."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            try:
+                sa = new_object("ServiceAccount", "wedged", "default")
+                await store.create("serviceaccounts", sa)
+                stored = await store.get(
+                    "serviceaccounts", "default/wedged")
+                uid = stored["metadata"]["uid"]
+                suffix = uid.replace("-", "")[:6]
+                for name in ("wedged-token",
+                             f"wedged-token-{suffix}"):
+                    await store.create("secrets", new_object(
+                        "Secret", name, "default",
+                        type="Opaque", data={"x": "y"}))
+                # No workers: sync() runs by hand, so the squats are
+                # guaranteed in place before the controller looks.
+                factory = InformerFactory(store)
+                tc = TokenController(store)
+                tc.setup(factory)
+                factory.start()
+                await factory.wait_for_sync()
+                with self.assertLogs(
+                        "kubernetes_tpu.controllers.serviceaccount",
+                        level="WARNING") as logs:
+                    await tc.sync("default/wedged")
+                self.assertTrue(any("wedged-token" in ln
+                                    for ln in logs.output))
+
+                async def squat_event():
+                    evs = (await store.list(
+                        "events", namespace="default")).items
+                    return [e for e in evs
+                            if e.get("reason") == "TokenSecretSquatted"]
+                deadline = asyncio.get_event_loop().time() + 5.0
+                evs = []
+                while asyncio.get_event_loop().time() < deadline:
+                    evs = await squat_event()
+                    if evs:
+                        break
+                    await asyncio.sleep(0.02)
+                self.assertTrue(evs, "no TokenSecretSquatted Event")
+                self.assertEqual(evs[0]["type"], "Warning")
+                self.assertEqual(
+                    evs[0]["involvedObject"]["name"], "wedged")
+                # resyncs dead-end identically: same warning, and the
+                # SA never mirrors a dead name
+                await tc.sync("default/wedged")
+                sa_now = await store.get(
+                    "serviceaccounts", "default/wedged")
+                self.assertFalse(sa_now.get("secrets"))
+                factory.stop()
+            finally:
+                store.stop()
+        run(body())

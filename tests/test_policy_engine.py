@@ -310,6 +310,227 @@ class TestValidatingAdmissionPolicy:
 
 
 # ---------------------------------------------------------------------------
+# VAP breadth: matchConditions, variables, messageExpression,
+# auditAnnotations, DELETE/object=null (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class TestVAPBreadth:
+    def test_match_conditions_gate_and_failure_policy(self):
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            pol = make_validating_admission_policy("cond", [
+                {"expression": "1 == 2", "message": "always denies"}],
+                match_constraints={"resourceRules": [
+                    {"resources": ["pods"], "operations": ["CREATE"]}]})
+            pol["spec"]["matchConditions"] = [
+                {"name": "only-special",
+                 "expression":
+                     "object.metadata.name.startsWith('special')"}]
+            await store.create("validatingadmissionpolicies", pol)
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("cond-b", "cond"))
+            rs = RemoteStore(api.url)
+            # condition false → the policy does not apply at all
+            assert (await rs.create("pods", make_pod("plain")))
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", make_pod("special-1"))
+            assert "always denies" in str(ei.value)
+            # condition ERROR obeys failurePolicy: Fail denies …
+            bad = make_validating_admission_policy("cond-err", [
+                {"expression": "1 == 1"}],
+                match_constraints={"resourceRules": [
+                    {"resources": ["pods"], "operations": ["CREATE"]}]})
+            bad["spec"]["matchConditions"] = [
+                {"name": "boom",
+                 "expression": "object.spec.noSuchField == 1"}]
+            await store.create("validatingadmissionpolicies", bad)
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("cond-err-b", "cond-err"))
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", make_pod("anyname"))
+            assert "matchCondition" in str(ei.value)
+            # … and Ignore skips the policy
+            ign = await store.get("validatingadmissionpolicies",
+                                  "cond-err")
+            ign["spec"]["failurePolicy"] = "Ignore"
+            await store.update("validatingadmissionpolicies", ign)
+            assert (await rs.create("pods", make_pod("anyname2")))
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_variables_composition_and_message_expression(self):
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            pol = make_validating_admission_policy("vars", [
+                {"expression": "size(variables.cnames) >= 1 and "
+                               "variables.first != 'forbidden'",
+                 "message": "static fallback",
+                 "messageExpression":
+                     "'container ' + variables.first + ' is forbidden'"}],
+                match_constraints={"resourceRules": [
+                    {"resources": ["pods"], "operations": ["CREATE"]}]})
+            pol["spec"]["variables"] = [
+                {"name": "cnames",
+                 "expression":
+                     "[c.name for c in object.spec.containers]"},
+                # chained composition: a variable referencing a variable
+                {"name": "first", "expression": "variables.cnames[0]"},
+            ]
+            await store.create("validatingadmissionpolicies", pol)
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("vars-b", "vars"))
+            rs = RemoteStore(api.url)
+            assert (await rs.create("pods", make_pod("fine")))
+            bad = make_pod("bad")
+            bad["spec"]["containers"][0]["name"] = "forbidden"
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", bad)
+            # messageExpression composed the deny message
+            assert "container forbidden is forbidden" in str(ei.value)
+            # broken messageExpression falls back to the static message
+            pol2 = await store.get("validatingadmissionpolicies", "vars")
+            pol2["spec"]["validations"][0]["messageExpression"] = \
+                "object.spec.doesNotExist"
+            await store.update("validatingadmissionpolicies", pol2)
+            bad2 = make_pod("bad2")
+            bad2["spec"]["containers"][0]["name"] = "forbidden"
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", bad2)
+            assert "static fallback" in str(ei.value)
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_variables_reevaluate_per_binding_params(self):
+        """A params-referencing variable must see EACH binding's own
+        params (fresh memo per binding): two bindings with different
+        ConfigMaps enforce different caps on the same policy."""
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            await store.create("configmaps",
+                               make_config_map("cap5", data={"max": "5"}))
+            await store.create("configmaps",
+                               make_config_map("cap9", data={"max": "9"}))
+            pol = make_validating_admission_policy("vcap", [
+                {"expression":
+                     "int(object.spec.priority) <= variables.cap",
+                 "messageExpression":
+                     "'cap ' + string(variables.cap) + ' exceeded'"}],
+                param_kind="ConfigMap",
+                match_constraints={"resourceRules": [
+                    {"resources": ["pods"], "operations": ["CREATE"]}]})
+            pol["spec"]["variables"] = [
+                {"name": "cap", "expression": "int(params.data.max)"}]
+            await store.create("validatingadmissionpolicies", pol)
+            # LOOSE binding first: priority 7 passes b9 (memoizing
+            # cap=9), then b5 must deny with ITS cap — a memo leaked
+            # across bindings would reuse 9 and wrongly admit.
+            for bname, cm in (("b9", "cap9"), ("b5", "cap5")):
+                await store.create(
+                    "validatingadmissionpolicybindings",
+                    make_vap_binding(bname, "vcap", param_ref={
+                        "name": cm, "namespace": "default"}))
+            rs = RemoteStore(api.url)
+            assert (await rs.create("pods", make_pod("p4", priority=4)))
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", make_pod("p7", priority=7))
+            assert "cap 5 exceeded" in str(ei.value)
+            # Drop the tighter binding: priority 7 is fine under cap9.
+            await store.delete("validatingadmissionpolicybindings", "b5")
+            assert (await rs.create("pods", make_pod("p7b", priority=7)))
+            with pytest.raises(Invalid) as ei:
+                await rs.create("pods", make_pod("p10", priority=10))
+            assert "cap 9 exceeded" in str(ei.value)
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_audit_annotations_flow_into_audit_event(self):
+        """auditAnnotations publish on the request's ResponseComplete
+        event as annotations["<policy>/<key>"] — the contextvar seam
+        between the VAP stage and the audit pipeline."""
+        async def body():
+            from kubernetes_tpu.policy import AuditPipeline, AuditPolicy
+            audit = AuditPipeline(AuditPolicy.metadata_for_all())
+            store, engine, api, wire = await _policy_cluster(audit=audit)
+            pol = make_validating_admission_policy("annot", [
+                {"expression": "1 == 1"}],
+                match_constraints={"resourceRules": [
+                    {"resources": ["pods"], "operations": ["CREATE"]}]})
+            pol["spec"]["auditAnnotations"] = [
+                {"key": "pod-name",
+                 "valueExpression":
+                     "'seen-' + object.metadata.name"},
+                # null value → annotation omitted, no error
+                {"key": "absent",
+                 "valueExpression":
+                     "object.metadata.labels['x'] if "
+                     "has(object.metadata.labels['x']) else None"},
+            ]
+            await store.create("validatingadmissionpolicies", pol)
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("annot-b", "annot"))
+            rs = RemoteStore(api.url)
+            await rs.create("pods", make_pod("a-pod"))
+            await asyncio.sleep(0.05)
+            done = [e for e in audit.sink.entries
+                    if e["stage"] == "ResponseComplete"
+                    and e["objectRef"]["name"] == "a-pod"]
+            assert done, audit.sink.entries
+            ann = done[0].get("annotations") or {}
+            assert ann.get("annot/pod-name") == "seen-a-pod"
+            assert "annot/absent" not in ann
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+    def test_delete_object_null_on_both_wires(self):
+        """DELETE runs expression policies with object=null and the
+        stored object as oldObject (the reference contract), routed
+        through admission on the HTTP and KTPU wires alike."""
+        async def body():
+            store, engine, api, wire = await _policy_cluster()
+            pol = make_validating_admission_policy("no-del", [
+                {"expression": "object == None and "
+                               "oldObject.metadata.name != 'protected'",
+                 "message": "protected pods cannot be deleted"}],
+                match_constraints={"resourceRules": [
+                    {"resources": ["pods"],
+                     "operations": ["DELETE"]}]})
+            await store.create("validatingadmissionpolicies", pol)
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("no-del-b", "no-del"))
+            rs = RemoteStore(api.url)
+            # the CREATE is outside the DELETE-only rule
+            await rs.create("pods", make_pod("protected"))
+            await rs.create("pods", make_pod("plain"))
+            with pytest.raises(Invalid) as ei:
+                await rs.delete("pods", "default/protected")
+            assert "cannot be deleted" in str(ei.value)
+            await rs.delete("pods", "default/plain")  # allowed
+            c = WireStore(wire.target)
+            with pytest.raises(Invalid) as ei:
+                await c.delete("pods", "default/protected")
+            assert "cannot be deleted" in str(ei.value)
+            assert (await store.get("pods", "default/protected"))
+            await c.close()
+            await rs.close()
+            await wire.stop()
+            await api.stop()
+            store.stop()
+        run(body())
+
+
+# ---------------------------------------------------------------------------
 # chain order, both wires
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,424 @@
+"""O(matching) policy dispatch (policy/vap.py, ISSUE 15): randomized
+index-vs-linear differential parity over generated policy sets
+(wildcard rules, namespace-selector overlap, matchConditions,
+DELETE/object=null, param refs, variables, messageExpression),
+mutation invalidation mid-stream, and the tier-1 smoke contract —
+index active by default, KTPU_POLICY_INDEX=0 structural degrade,
+residue-path non-vacuity, namespace-memo invalidation."""
+
+import asyncio
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    make_config_map,
+    make_namespace,
+    make_pod,
+    make_validating_admission_policy,
+    make_vap_binding,
+)
+from kubernetes_tpu.policy import PolicyEngine
+from kubernetes_tpu.policy.vap import PolicyDenied
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+from kubernetes_tpu.utils import flags
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def outcome(engine, obj, resource, op, old=None):
+    """None (allowed) or the exact deny message — the bit the
+    differential compares."""
+    try:
+        engine.validate(obj, resource, op, old_object=old)
+        return None
+    except PolicyDenied as e:
+        return str(e)
+
+
+def evals_total(engine) -> float:
+    return sum(engine.evaluations._values.values())
+
+
+# ---------------------------------------------------------------------------
+# generators
+# ---------------------------------------------------------------------------
+
+_NS_LABEL_POOL = [
+    {"team": "a"}, {"team": "b"}, {"env": "prod"},
+    {"env": "prod", "team": "a"}, {},
+]
+
+_SELECTOR_POOL = [
+    None,
+    {"matchLabels": {"team": "a"}},
+    {"matchLabels": {"env": "prod"}},
+    {"matchLabels": {"team": "b", "env": "prod"}},
+    {},  # empty selector: matches every namespace (reference)
+]
+
+_EXPR_POOL = [
+    # (expression, message) — all compile; some error at runtime on
+    # non-pod shapes or missing params (failurePolicy coverage).
+    ("size(object.spec.containers) >= 1", "needs containers"),
+    ("object.metadata.name != 'deny-me'", "denied by name"),
+    ("has(object.spec)", "no spec"),
+    ("object.spec.missingField == 1", "runtime error path"),
+    ("int(params.data.max) >= 10", "param gate"),
+    ("oldObject.metadata.name != 'protected'", "protected"),
+]
+
+_CONDITION_POOL = [
+    "object.metadata.name != 'skip'",
+    "has(object.metadata.labels)",
+    "request.operation != 'UPDATE'",
+]
+
+_RESOURCE_POOL = [["pods"], ["configmaps"], ["secrets"],
+                  ["pods", "configmaps"], ["*"]]
+_OP_POOL = [["CREATE"], ["CREATE", "UPDATE"], ["DELETE"], ["*"], None]
+
+
+async def _seed_cluster(store, rng: random.Random, n_policies: int):
+    for i, labels in enumerate(_NS_LABEL_POOL):
+        ns = make_namespace(f"ns-{i}")
+        if labels:
+            ns["metadata"]["labels"] = dict(labels)
+        await store.create("namespaces", ns)
+    await store.create(
+        "configmaps", make_config_map("caps", data={"max": "50"}))
+    for i in range(n_policies):
+        name = f"pol-{i}"
+        expr, msg = rng.choice(_EXPR_POOL)
+        constraints = {}
+        rules = rng.choice(_RESOURCE_POOL)
+        ops = rng.choice(_OP_POOL)
+        rule = {"resources": rules}
+        if ops is not None:
+            rule["operations"] = ops
+        if rng.random() < 0.9:
+            constraints["resourceRules"] = [rule]
+        sel = rng.choice(_SELECTOR_POOL)
+        if sel is not None:
+            constraints["namespaceSelector"] = sel
+        kwargs = {}
+        if "params" in expr:
+            kwargs["param_kind"] = "ConfigMap"
+        policy = make_validating_admission_policy(
+            name, [{"expression": expr, "message": msg}],
+            failure_policy=rng.choice(["Fail", "Ignore"]),
+            match_constraints=constraints or None, **kwargs)
+        if rng.random() < 0.3:
+            policy["spec"]["matchConditions"] = [
+                {"name": "c0", "expression": rng.choice(_CONDITION_POOL)}]
+        if rng.random() < 0.3:
+            policy["spec"]["variables"] = [
+                {"name": "nm", "expression": "object.metadata.name"}]
+            policy["spec"]["validations"].append(
+                {"expression": "variables.nm != 'var-deny'",
+                 "message": "variable deny",
+                 "messageExpression":
+                     "'variable denied: ' + variables.nm"})
+        await store.create("validatingadmissionpolicies", policy)
+        if rng.random() < 0.9:  # ~10% stay unbound (inert, reference)
+            param_ref = None
+            if "params" in expr and rng.random() < 0.8:
+                param_ref = {"name": "caps", "namespace": "default"}
+            await store.create(
+                "validatingadmissionpolicybindings",
+                make_vap_binding(f"{name}-b", name,
+                                 param_ref=param_ref))
+
+
+def _rand_request(rng: random.Random):
+    name = rng.choice(["ok", "deny-me", "skip", "protected",
+                       "var-deny", "plain"])
+    ns = rng.choice([f"ns-{i}" for i in range(len(_NS_LABEL_POOL))]
+                    + ["default", "ghost-ns"])
+    resource = rng.choice(["pods", "configmaps", "secrets", "leases"])
+    op = rng.choice(["create", "update", "delete"])
+    if resource == "pods":
+        obj = make_pod(name, namespace=ns)
+    else:
+        obj = {"kind": "X", "metadata": {"name": name, "namespace": ns},
+               "data": {"k": "v"}}
+    if op == "delete":
+        return None, resource, op, obj
+    old = None
+    if op == "update":
+        old = {**obj, "metadata": {**obj["metadata"], "old": "1"}}
+    return obj, resource, op, old
+
+
+# ---------------------------------------------------------------------------
+# differential parity
+# ---------------------------------------------------------------------------
+
+class TestIndexLinearParity:
+    @pytest.mark.parametrize("seed", [7, 23, 101])
+    def test_randomized_verdict_parity(self, seed):
+        """Index-vs-linear verdicts bit-identical (exact deny message)
+        over a generated policy set, and the evaluation counters agree
+        request-by-request — the shared evaluation core really did run
+        the same expressions."""
+        async def body():
+            rng = random.Random(seed)
+            store = new_cluster_store()
+            install_core_validation(store)
+            await _seed_cluster(store, rng, n_policies=40)
+            idx_eng = PolicyEngine(store)
+            lin_eng = PolicyEngine(store)
+            for _ in range(60):
+                obj, resource, op, old = _rand_request(rng)
+                with flags.scoped_set("KTPU_POLICY_INDEX", "1"):
+                    e0 = evals_total(idx_eng)
+                    r_idx = outcome(idx_eng, obj, resource, op, old)
+                    d_idx = evals_total(idx_eng) - e0
+                with flags.scoped_set("KTPU_POLICY_INDEX", "0"):
+                    e0 = evals_total(lin_eng)
+                    r_lin = outcome(lin_eng, obj, resource, op, old)
+                    d_lin = evals_total(lin_eng) - e0
+                assert r_idx == r_lin, (resource, op, r_idx, r_lin)
+                assert d_idx == d_lin, (resource, op, d_idx, d_lin)
+            # the index really dispatched (non-vacuous differential)
+            assert idx_eng.index_rebuilds.value() >= 1
+            assert lin_eng.index_rebuilds.value() == 0
+            store.stop()
+        run(body())
+
+    def test_mutation_invalidation_mid_stream(self):
+        """Policy/binding writes and namespace label writes between
+        requests: the incremental index must equal a from-scratch
+        engine after every mutation."""
+        async def body():
+            rng = random.Random(99)
+            store = new_cluster_store()
+            install_core_validation(store)
+            await _seed_cluster(store, rng, n_policies=15)
+            live = PolicyEngine(store)
+            reqs = [_rand_request(rng) for _ in range(10)]
+            for step in range(6):
+                if step == 1:  # add a new always-matching policy
+                    await store.create(
+                        "validatingadmissionpolicies",
+                        make_validating_admission_policy("mid-add", [
+                            {"expression":
+                                 "object.metadata.name != 'deny-me'",
+                             "message": "mid-add deny"}]))
+                    await store.create(
+                        "validatingadmissionpolicybindings",
+                        make_vap_binding("mid-add-b", "mid-add"))
+                elif step == 2:  # unbind it again
+                    await store.delete(
+                        "validatingadmissionpolicybindings", "mid-add-b")
+                elif step == 3:  # flip a namespace's labels
+                    ns = await store.get("namespaces", "ns-0")
+                    ns["metadata"]["labels"] = {"team": "b"}
+                    await store.update("namespaces", ns)
+                elif step == 4:  # delete a policy outright
+                    await store.delete(
+                        "validatingadmissionpolicies", "pol-0")
+                elif step == 5:  # restore ns-0
+                    ns = await store.get("namespaces", "ns-0")
+                    ns["metadata"]["labels"] = {"team": "a"}
+                    await store.update("namespaces", ns)
+                fresh = PolicyEngine(store)
+                for obj, resource, op, old in reqs:
+                    with flags.scoped_set("KTPU_POLICY_INDEX", "1"):
+                        r_live = outcome(live, obj, resource, op, old)
+                    with flags.scoped_set("KTPU_POLICY_INDEX", "0"):
+                        r_fresh = outcome(fresh, obj, resource, op, old)
+                    assert r_live == r_fresh, (step, resource, op)
+            store.stop()
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: structural contracts
+# ---------------------------------------------------------------------------
+
+async def _small_cluster():
+    store = new_cluster_store()
+    install_core_validation(store)
+    prod = make_namespace("prod")
+    prod["metadata"]["labels"] = {"env": "prod"}
+    await store.create("namespaces", prod)
+    await store.create(
+        "validatingadmissionpolicies",
+        make_validating_admission_policy("exact", [
+            {"expression": "object.metadata.name != 'deny-me'",
+             "message": "exact deny"}],
+            match_constraints={"resourceRules": [
+                {"resources": ["pods"], "operations": ["CREATE"]}]}))
+    await store.create("validatingadmissionpolicybindings",
+                       make_vap_binding("exact-b", "exact"))
+    await store.create(
+        "validatingadmissionpolicies",
+        make_validating_admission_policy("wild", [
+            {"expression": "object.metadata.name != 'banned'",
+             "message": "wildcard deny"}],
+            match_constraints={"resourceRules": [
+                {"resources": ["*"], "operations": ["CREATE"]}]}))
+    await store.create("validatingadmissionpolicybindings",
+                       make_vap_binding("wild-b", "wild"))
+    return store
+
+
+class TestIndexSmoke:
+    def test_index_active_by_default(self):
+        """Flagless: the exact-key index serves pod creates (hits
+        counted, structures built) — the O(matching) path is the
+        default, not an opt-in."""
+        async def body():
+            store = await _small_cluster()
+            eng = PolicyEngine(store)
+            eng.validate(make_pod("fine"), "pods", "create")
+            assert eng._index is not None
+            assert eng.index_hits.value() >= 1
+            assert eng.index_rebuilds.value() == 1
+            # a second request reuses the index: no extra rebuild
+            eng.validate(make_pod("fine2"), "pods", "create")
+            assert eng.index_rebuilds.value() == 1
+            store.stop()
+        run(body())
+
+    def test_kill_switch_structural_degrade(self):
+        """KTPU_POLICY_INDEX=0: verdicts identical, but NO index
+        structures exist and no index counters move — the linear scan
+        is structural, not an indexed path with extra steps."""
+        async def body():
+            store = await _small_cluster()
+            eng = PolicyEngine(store)
+            with flags.scoped_set("KTPU_POLICY_INDEX", "0"):
+                with pytest.raises(PolicyDenied) as ei:
+                    eng.validate(make_pod("deny-me"), "pods", "create")
+                assert "exact deny" in str(ei.value)
+                eng.validate(make_pod("fine"), "pods", "create")
+            assert eng._index is None
+            assert eng.index_rebuilds.value() == 0
+            assert eng.index_hits.value() == 0
+            assert eng.index_residue_scans.value() == 0
+            store.stop()
+        run(body())
+
+    def test_residue_path_non_vacuous(self):
+        """Wildcard rules land in the residue list and still deny —
+        the linear tail is exercised, not just indexed buckets."""
+        async def body():
+            store = await _small_cluster()
+            eng = PolicyEngine(store)
+            with pytest.raises(PolicyDenied) as ei:
+                eng.validate(make_pod("banned"), "pods", "create")
+            assert "wildcard deny" in str(ei.value)
+            assert eng.index_residue_scans.value() >= 1
+            # a non-pod resource only the wildcard can match: served
+            # exclusively from the residue
+            hits0 = eng.index_hits.value()
+            with pytest.raises(PolicyDenied):
+                eng.validate(
+                    {"kind": "Secret",
+                     "metadata": {"name": "banned",
+                                  "namespace": "default"}},
+                    "secrets", "create")
+            assert eng.index_hits.value() == hits0
+            store.stop()
+        run(body())
+
+    def test_ns_selector_memo_invalidation(self):
+        """The interned-selector memo answers from cache across
+        requests and flips correctly when the namespace's labels
+        change (the mutator invalidation seam)."""
+        async def body():
+            store = await _small_cluster()
+            await store.create(
+                "validatingadmissionpolicies",
+                make_validating_admission_policy("prod-only", [
+                    {"expression": "has(object.spec.priority)",
+                     "message": "prod needs priority"}],
+                    match_constraints={
+                        "resourceRules": [{"resources": ["pods"],
+                                           "operations": ["CREATE"]}],
+                        "namespaceSelector": {
+                            "matchLabels": {"env": "prod"}}}))
+            await store.create("validatingadmissionpolicybindings",
+                               make_vap_binding("prod-b", "prod-only"))
+            eng = PolicyEngine(store)
+            with pytest.raises(PolicyDenied):
+                eng.validate(make_pod("p", namespace="prod"),
+                             "pods", "create")
+            assert eng._ns_memo.get("prod")  # memoized True
+            # de-label the namespace: memo entry must invalidate
+            ns = await store.get("namespaces", "prod")
+            ns["metadata"]["labels"] = {}
+            await store.update("namespaces", ns)
+            assert "prod" not in eng._ns_memo
+            eng.validate(make_pod("p2", namespace="prod"),
+                         "pods", "create")  # selector no longer matches
+            store.stop()
+        run(body())
+
+    def test_sig_tables_bounded_under_selector_churn(self):
+        """Policy churn with ever-new selector contents must not grow
+        the signature interning tables without bound: each rebuild
+        re-interns from the live active set only."""
+        async def body():
+            store = await _small_cluster()
+            eng = PolicyEngine(store)
+            for round_ in range(10):
+                name = f"churn-{round_}"
+                await store.create(
+                    "validatingadmissionpolicies",
+                    make_validating_admission_policy(name, [
+                        {"expression": "1 == 1"}],
+                        match_constraints={
+                            "resourceRules": [
+                                {"resources": ["pods"],
+                                 "operations": ["CREATE"]}],
+                            "namespaceSelector": {"matchLabels": {
+                                "churn": f"v{round_}"}}}))
+                await store.create(
+                    "validatingadmissionpolicybindings",
+                    make_vap_binding(f"{name}-b", name))
+                eng.validate(make_pod(f"p{round_}", namespace="prod"),
+                             "pods", "create")
+                await store.delete(
+                    "validatingadmissionpolicybindings", f"{name}-b")
+                await store.delete(
+                    "validatingadmissionpolicies", name)
+            eng.validate(make_pod("last", namespace="prod"),
+                         "pods", "create")
+            # only the LIVE active set's selectors remain interned
+            # (the _small_cluster policies carry none)
+            assert len(eng._sig_ids) == 0
+            assert len(eng._sig_sel) == 0
+            store.stop()
+        run(body())
+
+    def test_shared_selector_one_signature(self):
+        """Policies carrying the SAME selector content intern to one
+        signature — one selector eval per namespace serves all."""
+        async def body():
+            store = await _small_cluster()
+            for i in range(5):
+                await store.create(
+                    "validatingadmissionpolicies",
+                    make_validating_admission_policy(f"shared-{i}", [
+                        {"expression": "1 == 1"}],
+                        match_constraints={
+                            "resourceRules": [
+                                {"resources": ["pods"],
+                                 "operations": ["CREATE"]}],
+                            "namespaceSelector": {
+                                "matchLabels": {"env": "prod"}}}))
+                await store.create(
+                    "validatingadmissionpolicybindings",
+                    make_vap_binding(f"shared-{i}-b", f"shared-{i}"))
+            eng = PolicyEngine(store)
+            eng.validate(make_pod("p", namespace="prod"),
+                         "pods", "create")
+            assert len(eng._sig_ids) == 1
+            assert len(eng._ns_memo["prod"]) == 1
+            store.stop()
+        run(body())

@@ -151,6 +151,27 @@ class TestLockDiscipline:
                 with self._lock:
                     sock.sendall(b"x")            # LK204
 
+            def rotate(self, path):
+                with self._lock:
+                    with open(path, "a") as f:    # LK206
+                        f.write("x")
+
+            def shuffle(self, path):
+                with self._lock:
+                    import os
+                    os.replace(path, path + ".1")  # LK206
+
+            def one_statement(self, path):
+                with self._lock, open(path, "a") as f:  # LK206 too
+                    f.write("x")
+
+            def rotate_outside(self, path):
+                segment = None
+                with self._lock:
+                    segment = dict(self._values)
+                with open(path, "a") as f:        # clean: lock released
+                    f.write(str(segment))
+
             def write(self, k):
                 with self._lock:
                     self._values[k] = 1
@@ -186,6 +207,18 @@ class TestLockDiscipline:
         assert "LK202" in codes     # await under a threading lock
         assert "LK203" in codes     # device fetch under a lock
         assert "LK204" in codes     # wire send under a lock
+
+    def test_file_io_under_lock(self, tmp_path):
+        """LK206 (ISSUE 15, the audit sink workers): open()/os.replace
+        under a held lock flagged — in `with open(...)` context-expr
+        form and the bare-call form — while I/O after the lock is
+        released stays clean."""
+        found = self._run(tmp_path)
+        lk206 = [f for f in found if f.code == "LK206"]
+        assert {f.symbol for f in lk206} == {
+            "HeldAcross.rotate:open", "HeldAcross.shuffle:os.replace",
+            "HeldAcross.one_statement:open"}
+        assert not any("rotate_outside" in f.symbol for f in found)
 
     def test_unlocked_iteration_of_guarded_state(self, tmp_path):
         found = self._run(tmp_path)
@@ -236,7 +269,8 @@ class TestFlagRegistry:
         """Every flag: registered, documented, expected default — and
         NAMED here, which is what the FL304 'every flag has a test'
         check greps for: KTPU_SERVING, KTPU_CLASS_PLANES,
-        KTPU_WAVEFRONT, KTPU_WAVE_WIDTH, KTPU_WATCH_CACHE, KTPU_SHARDS,
+        KTPU_WAVEFRONT, KTPU_WAVE_WIDTH, KTPU_WATCH_CACHE,
+        KTPU_POLICY_INDEX, KTPU_SHARDS,
         KTPU_SHARD_THRESHOLD, KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH,
         KTPU_SHORTLIST_K, KTPU_ADMISSION_WINDOW,
         KTPU_TRACE_THRESHOLD_MS, KTPU_DATA_DIR, KTPU_LOCK_CHECK,
@@ -248,6 +282,7 @@ class TestFlagRegistry:
             "KTPU_WAVEFRONT": True,
             "KTPU_WAVE_WIDTH": None,
             "KTPU_WATCH_CACHE": True,
+            "KTPU_POLICY_INDEX": True,
             "KTPU_SHARDS": None,
             "KTPU_SHARD_THRESHOLD": 100_000,
             "KTPU_CLASS_PAD": 31,
@@ -267,7 +302,7 @@ class TestFlagRegistry:
         kills = {n for n, f in flags.FLAGS.items() if f.kill_switch}
         assert kills == {"KTPU_SERVING", "KTPU_CLASS_PLANES",
                          "KTPU_WAVEFRONT", "KTPU_WATCH_CACHE",
-                         "KTPU_SHARDS"}
+                         "KTPU_POLICY_INDEX", "KTPU_SHARDS"}
 
     def test_parse_behaviors(self, monkeypatch):
         from kubernetes_tpu.utils import flags
@@ -339,6 +374,36 @@ class TestMetricsLint:
         assert not any(clean in syms
                        for syms in by_code.values() for syms in [syms]
                        if any(clean == s.split(":")[0] for s in syms))
+
+    def test_registrations_outside_registry_scanned(self, tmp_path):
+        """ISSUE 15 widened the scan: a counter constructed in
+        policy/audit.py (the sink counters) is linted like one in
+        metrics/registry.py — a bad name anywhere fails."""
+        mod = _module(tmp_path, "kubernetes_tpu/policy/audit.py", """
+            class Sink:
+                def __init__(self, r):
+                    self.drops = r.counter("audit_dropped", "no _total")
+        """)
+        found = metrics_lint.run([mod])
+        assert [f.code for f in found] == ["MT402"]
+
+    def test_real_sink_counters_visible_to_pass(self):
+        """Non-vacuity: the pass actually reaches the live audit/vap
+        registrations (policy_index_*, audit_webhook_*, rotation) —
+        and finds them clean."""
+        from kubernetes_tpu.analysis.engine import load_modules
+        mods = [m for m in load_modules()
+                if m.rel in ("kubernetes_tpu/policy/audit.py",
+                             "kubernetes_tpu/policy/vap.py")]
+        names = {name for m in mods
+                 for _k, name, _l, _ln in metrics_lint._registrations(m)}
+        assert {"policy_index_hits_total",
+                "policy_index_residue_scans_total",
+                "policy_index_rebuilds_total",
+                "audit_log_rotations_total",
+                "audit_webhook_batches_total",
+                "audit_webhook_retries_total"} <= names
+        assert metrics_lint.run(mods) == []
 
     def test_real_registry_would_catch_ms_gauge(self, tmp_path):
         """The r17 defect as a regression fixture: a `_ms` gauge in the
