@@ -259,6 +259,15 @@ def main(argv=None) -> int:
                     help="KTPU_SERVING kill switch: 'off' degrades the "
                          "dispatch loop structurally to the pre-serving "
                          "shape (the before/after sweep knob)")
+    ap.add_argument("--solve-mode", choices=["greedy", "optimal", "auto"],
+                    default=None,
+                    help="KTPU_SOLVE_MODE: 'greedy' pins the r18 "
+                         "wavefront scan (bit-identical kill switch), "
+                         "'optimal' forces the Sinkhorn transport plan + "
+                         "feasible rounding on eligible chunks, 'auto' "
+                         "(the default policy) routes drain-scale and "
+                         "gang chunks only. The r20 fragmentation pair "
+                         "sweeps greedy vs optimal on one preset")
     ap.add_argument("--churn", action="store_true",
                     help="ChurnDay mode (perf/churn): instead of one "
                          "bulk drain, sweep an OPEN-LOOP Poisson/burst/"
@@ -367,6 +376,9 @@ def main(argv=None) -> int:
     if args.serving == "off":
         import os
         os.environ["KTPU_SERVING"] = "0"
+    if args.solve_mode is not None:
+        import os
+        os.environ["KTPU_SOLVE_MODE"] = args.solve_mode
     if args.class_pad is not None:
         import os
         if args.class_pad <= 0:
@@ -468,6 +480,12 @@ def main(argv=None) -> int:
         "unit": "pods/s",
         "vs_baseline": round(
             detail["throughput_pods_per_sec"] / REFERENCE_PODS_PER_SEC, 3),
+        # r20 headline: packing quality next to pods/s — occupied-node
+        # fragmentation is the figure optimal mode moves; the all-nodes
+        # figure stays for continuity with earlier rounds.
+        "fragmentation_pct": detail["fragmentation_pct"],
+        "fragmentation_occupied_pct": detail["fragmentation_occupied_pct"],
+        "solve_mode": args.solve_mode or "auto",
     }))
     return 0
 

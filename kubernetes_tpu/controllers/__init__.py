@@ -15,6 +15,7 @@ from kubernetes_tpu.controllers.deployment import (
     DeploymentController,
     make_deployment,
 )
+from kubernetes_tpu.controllers.descheduler import DeschedulerController
 from kubernetes_tpu.controllers.garbagecollector import (
     GarbageCollectorController,
     NamespaceController,
@@ -77,6 +78,7 @@ __all__ = [
     "NamespaceController",
     "Controller", "ControllerManager",
     "DaemonSetController", "make_daemonset",
+    "DeschedulerController",
     "DeploymentController", "make_deployment",
     "JobController", "make_job",
     "KwokController", "NodeLifecycleController", "PodGCController",

@@ -381,6 +381,25 @@ class ChurnMetrics:
             registry._metrics.setdefault(m.name, m)
 
 
+class DeschedulerMetrics:
+    """Rebalance-descheduler counters (controllers/descheduler.py):
+    evict-and-replace consolidation moves actually issued. The
+    disruption budget bounds the per-cycle delta; the ChurnDay
+    rebalance family reports the phase total next to the
+    fragmentation-over-time curve."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = registry or Registry()
+        self.registry = r
+        self.evictions = r.counter(
+            "descheduler_evictions_total",
+            "Pods evicted (and re-created unbound) by the rebalance "
+            "descheduler's consolidation moves")
+
+    def register_into(self, registry: Registry) -> None:
+        registry._metrics.setdefault(self.evictions.name, self.evictions)
+
+
 #: verbs counted as mutating for apiserver_current_inflight_requests'
 #: request_kind label (the reference's mutating/readOnly split).
 _MUTATING_VERBS = frozenset(("create", "update", "patch", "delete"))
@@ -508,6 +527,28 @@ class SchedulerMetrics:
             "scheduler_tpu_solver_wave_replays_total",
             "Pods placed through the wavefront solve's exact serial "
             "replay")
+        #: Global-assignment observability (r20): chunks solved through
+        #: the Sinkhorn transport plan + feasible rounding, chunks the
+        #: tuner WANTED optimal but degraded to greedy (spread strategy
+        #: or per-pod planes make the C x N plan ineligible), the
+        #: iteration budget the latest optimal solve ran, and the
+        #: cluster fragmentation the placement left behind — mean free
+        #: fraction over OCCUPIED nodes, the quantity optimal mode
+        #: packs down and the descheduler consolidates.
+        self.solver_optimal_solves = r.counter(
+            "solver_optimal_mode_solves_total",
+            "Chunks solved through the Sinkhorn optimal-assignment mode")
+        self.solver_optimal_fallbacks = r.counter(
+            "solver_optimal_fallbacks_total",
+            "Chunks routed to optimal mode that degraded to the greedy "
+            "wavefront scan (ineligible planes or spread strategy)")
+        self.solver_sinkhorn_iterations = r.gauge(
+            "solver_sinkhorn_iterations",
+            "Sinkhorn iteration budget of the latest optimal-mode solve")
+        self.fragmentation_pct = r.gauge(
+            "scheduler_fragmentation_pct",
+            "Mean stranded-capacity fraction (pct) across occupied "
+            "nodes after the latest measured run")
         #: Sharded-control-plane observability (ROADMAP #5): per-shard
         #: host-prep rebuild counts (a shard increments only when its
         #: rows were actually rewritten — the incremental path's

@@ -295,6 +295,17 @@ class AdaptiveTuner:
     FAST_PATH_SEED_SOLVE_S = 1e-3
     FAST_PATH_CAP_MIN = 8
     FAST_PATH_CAP_MAX = 512
+    #: Batch-optimal (Sinkhorn) routing policy row (r20): `auto`
+    #: engages only where the latency budget allows — drain/rollout-
+    #: scale chunks and gang placement. The plan is a fixed per-chunk
+    #: device cost (KTPU_SINKHORN_ITERS dense (C,N) passes), so a chunk
+    #: below this many real pods keeps the greedy scan — the iteration
+    #: cost would dominate what the rounding saves. Serving single-pod
+    #: traffic never reaches this policy at all (solve_one is a separate
+    #: pinned program), and gang chunks route optimal at ANY width: all-
+    #: or-nothing placement is exactly where greedy's myopia strands
+    #: feasible gangs.
+    OPTIMAL_MIN_PODS = 64
     #: Serial fast-drain is only right while the OFFERED rate is within
     #: its capacity (1/fast_wall) with headroom: above this utilization
     #: the pipelined batch path must take over or the serial drain
@@ -365,6 +376,28 @@ class AdaptiveTuner:
         """Wavefront commit/replay sample from one finalized chunk."""
         self.wave_commits += commits
         self.wave_replays += replays
+
+    def solve_mode(self, p_real: int, has_gang: bool, spread: bool,
+                   class_mode: bool) -> tuple[str, bool]:
+        """('greedy' | 'optimal', structural_fallback) for one chunk —
+        the KTPU_SOLVE_MODE policy row. 'greedy' pins the r18 scan call
+        graph (the kill switch). Optimal requires class planes (the
+        (C,N) cost matrix IS the class dictionary) and a non-spread
+        chunk (the spread scan's non-monotone domain gating has no
+        transport relaxation); an ineligible chunk degrades structurally
+        to greedy with the fallback bit set so
+        solver_optimal_fallbacks_total records it. Under 'auto' the
+        optimal mode engages for gang chunks and for chunks of at least
+        OPTIMAL_MIN_PODS real pods (drain/rollout waves)."""
+        raw = flags.get("KTPU_SOLVE_MODE")
+        if raw == "greedy":
+            return "greedy", False
+        eligible = class_mode and not spread
+        if raw == "optimal":
+            return ("optimal", False) if eligible else ("greedy", True)
+        if not (has_gang or p_real >= self.OPTIMAL_MIN_PODS):
+            return "greedy", False
+        return ("optimal", False) if eligible else ("greedy", True)
 
     def wave_width(self, chunk: int) -> int:
         """Wavefront width for a chunk; 1 = degenerate one-member waves.
@@ -564,7 +597,7 @@ def _solve_program():
             _SOLVE_PROGRAM = partial(
                 jax.jit,
                 static_argnames=("strategy", "use_spread", "shortlist_k",
-                                 "wave_w"),
+                                 "wave_w", "solve_mode"),
                 donate_argnums=(1,))(_mask_solve_update.__wrapped__)
     return _SOLVE_PROGRAM
 
@@ -592,7 +625,7 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
 
 @partial(jax.jit,
          static_argnames=("strategy", "use_spread", "shortlist_k",
-                          "wave_w"))
+                          "wave_w", "solve_mode"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        cls_idx, exc_col,
                        taint_f_mat, taint_p_mat, class_mask, class_scores,
@@ -601,9 +634,9 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
                        sp_min_ok, sp_haskey,
                        sp_applies, sp_contrib, perms, gang_onehot,
-                       gang_required,
+                       gang_required, sink_iters, sink_temp,
                        strategy: str, use_spread: bool, shortlist_k: int,
-                       wave_w: int):
+                       wave_w: int, solve_mode: str = "greedy"):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -646,6 +679,21 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     narrow winner global. Assignments are bit-identical to the full scan
     by construction (tests/test_shortlist_solver.py is the differential
     guard).
+
+    solve_mode == "optimal" is the r20 BATCH-OPTIMAL mode: an entropic
+    transport plan (ops/solver.sinkhorn_plan) over the same (C,N) class
+    planes replaces the greedy scorer for this chunk. The plan's cost
+    matrix is the greedy scorer's own chunk-start scores (the warm
+    start — it refines exactly the preferences the r18 scan would have
+    ranked), its marginals are pods-per-class and remaining pod slots,
+    and its log becomes the scan's `static_scores` with the live
+    re-scoring weights zeroed — so the ROUNDING pass is the unmodified
+    r18 scan machinery against live capacity planes and every emitted
+    assignment is feasible by construction (gang all-or-nothing masking
+    and multistart orders apply unchanged). "greedy" (the
+    KTPU_SOLVE_MODE kill switch and the structural-fallback route for
+    spread/per-pod chunks) traces the r18 call graph verbatim —
+    `sink_iters`/`sink_temp` are dead inputs there and XLA drops them.
 
     wave_w > 1 switches to the SPECULATIVE WAVEFRONT scans: W pods per
     scan step against the same carry, prefix-distinct argmax commits,
@@ -718,6 +766,23 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     wave_com = jnp.int32(0)
     wave_rep = jnp.int32(0)
     n_pad = alloc_q.shape[0]
+    if solve_mode == "optimal" and not use_spread:
+        # Batch-optimal mode (see docstring): transport plan over the
+        # class planes, then the SAME scans round it against live
+        # capacity with the re-scoring weights zeroed. Runs BEFORE the
+        # shortlist prefilter so a composed shortlist prunes the plan
+        # scores it will scan (exactness preserved).
+        sc0_cost = kernels.chunk_start_scores(
+            alloc_q, used_nz_q, c_req_nz_q, static_scores,
+            fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+            strategy)
+        row_counts = jnp.zeros(
+            (cmask.shape[0],), jnp.float32).at[cls_idx].add(1.0)
+        static_scores, _ = solver.sinkhorn_plan(
+            feasible, sc0_cost, row_counts, jnp.maximum(free_pods, 0),
+            sink_iters, sink_temp)
+        w_fit = jnp.float32(0.0)
+        w_bal = jnp.float32(0.0)
     if shortlist_k:
         # Shortlist prefilter: chunk-start live scores per pod CLASS
         # (C rows, not P — the planes already ARE class rows), top-K
@@ -2594,6 +2659,7 @@ class TPUBackend:
             "gang_required": gang_required,
             "shortlist_k": shortlist_k,
             "wave_w": wave_w,
+            "class_mode": class_reps is not None,
             "scan_width": (shortlist_k + P) if shortlist_k else ct.n_real,
         }
 
@@ -2655,6 +2721,24 @@ class TPUBackend:
         # variants that would all route to the same W=1 body.
         if use_spread and prep["shortlist_k"]:
             prep["wave_w"] = 0
+        # Solve-mode policy row (r20): greedy pins the r18 call graph;
+        # optimal routes the Sinkhorn plan + rounding. Transport plans
+        # tie across equally-attractive columns, so under optimal mode
+        # wave speculation would conflict-replay nearly every wave and
+        # the shortlist prefilter would re-derive what the plan already
+        # encodes — the rounding keeps the W=1 kill-switch scan shape
+        # (assignments are bit-identical at any W regardless; the
+        # differential suite pins it) and the full-row scan.
+        solve_mode, opt_fallback = self._tuner.solve_mode(
+            batch.p_real,
+            has_gang=prep["gang_onehot"] is not None,
+            spread=use_spread,
+            class_mode=prep.get("class_mode", False))
+        if solve_mode == "optimal":
+            prep["shortlist_k"] = 0
+            prep["wave_w"] = 0
+        prep["solve_mode"] = solve_mode
+        prep["optimal_fallback"] = opt_fallback
         if use_spread:
             sp_args = (sp["dev_dom"], sp["dev_cid"], sp["dev_counts"],
                        sp["dev_skew"], sp["dev_min_ok"], sp["dev_haskey"],
@@ -2673,8 +2757,10 @@ class TPUBackend:
                 p["w_fit"], p["w_bal"], p["w_taint"], p["taint_filter_on"],
                 *sp_args,
                 prep["dev_perms"], *self._gang_args(prep, batch),
+                np.int32(max(1, flags.get("KTPU_SINKHORN_ITERS"))),
+                np.float32(flags.get("KTPU_SINKHORN_TEMP")),
                 p["strategy"], use_spread, prep["shortlist_k"],
-                prep["wave_w"],
+                prep["wave_w"], solve_mode,
             )
         self._dev_used = used_pack2
         if use_spread:
@@ -2719,6 +2805,18 @@ class TPUBackend:
                 self.metrics.solver_shortlist_pods.inc(batch.p_real)
                 if nfall:
                     self.metrics.solver_shortlist_fallbacks.inc(nfall)
+            # Optimal-mode accounting (r20): solves count CHUNKS routed
+            # through the Sinkhorn plan; fallbacks count chunks the
+            # policy WANTED optimal but structure (spread / per-pod
+            # planes) degraded to greedy. The iterations gauge records
+            # what the latest optimal solve actually ran — fori_loop
+            # runs the flag's count exactly.
+            if run.get("solve_mode") == "optimal":
+                self.metrics.solver_optimal_solves.inc()
+                self.metrics.solver_sinkhorn_iterations.set(
+                    max(1, flags.get("KTPU_SINKHORN_ITERS")))
+            elif run.get("optimal_fallback"):
+                self.metrics.solver_optimal_fallbacks.inc()
             if ctx.ct.prep_shards > 1:
                 # Sharded-path solve accounting: the fused program spans
                 # every shard, so the wall is labeled with the shard

@@ -1651,3 +1651,108 @@ def fragmentation(free_q, alloc_q, valid):
         jnp.sum(alloc > 0, axis=1), 1)
     return 100.0 * jnp.sum(jnp.where(valid, per_node, 0.0)) / jnp.maximum(
         jnp.sum(valid), 1)
+
+
+@jax.jit
+def fragmentation_occupied(free_q, alloc_q, used_pods, valid):
+    """OCCUPIED-node fragmentation %: mean free-capacity fraction over
+    nodes hosting at least one pod. This is the r20 optimizable metric —
+    the all-nodes `fragmentation` above is placement-INVARIANT once every
+    pod places (total free capacity is fixed by the workload), while this
+    variant rewards concentrating load: packing the same pods onto fewer,
+    fuller nodes lowers it, spreading them raises it. 0 occupied nodes →
+    0.0 (an empty cluster is not fragmented)."""
+    occ = valid & (used_pods > 0)
+    alloc = alloc_q.astype(jnp.float32)
+    frac = jnp.where(alloc > 0, free_q.astype(jnp.float32) / alloc, 0.0)
+    per_node = jnp.sum(frac, axis=1) / jnp.maximum(
+        jnp.sum(alloc > 0, axis=1), 1)
+    return 100.0 * jnp.sum(jnp.where(occ, per_node, 0.0)) / jnp.maximum(
+        jnp.sum(occ), 1)
+
+
+#: annealing stages of the Sinkhorn temperature schedule (4T → 2T → T).
+SINKHORN_STAGES = 3
+
+
+@jax.jit
+def sinkhorn_plan(feasible, cost, row_counts, col_cap, iters, temp):
+    """Entropic-regularized transport plan over the (C, N) class planes
+    (the r20 batch-optimal solve mode — SURVEY §5's Sinkhorn row).
+
+    The class dictionary is what makes this affordable: the cost matrix
+    is C×N (pod equivalence classes × nodes), never P×N, so the whole
+    iteration runs on device planes that already exist. Marginals:
+
+    - row_counts (C,) f32 — pods per class this chunk (the row mass each
+      class must place; padding rides the reserved EMPTY class whose
+      all-false feasible row zeros its kernel row).
+    - col_cap (N,) — remaining pod slots per node, an INEQUALITY bound:
+      the column step caps column mass at capacity (the partial-transport
+      update v = min(1, b/col)) rather than forcing columns full, so
+      under-capacity nodes simply receive less mass.
+
+    Costs are the greedy scorer's own chunk-start scores (the warm
+    start), shifted per row so one temperature means the same thing at
+    any score scale. Temperature ANNEALS over SINKHORN_STAGES stages
+    (4T → 2T → T): early high-temperature rounds spread mass and settle
+    the capacity duals, late low-temperature rounds sharpen toward the
+    assignment vertex. `iters`/`temp` are traced (live KTPU_SINKHORN_ITERS
+    / KTPU_SINKHORN_TEMP knobs, no recompile); the loop lowers to a while.
+
+    Returns (log_plan (C,N) f32, plan (C,N) f32). log_plan is sanitized
+    (-1e30 on infeasible/non-finite entries) so it drops directly into
+    the scans as `static_scores` for the feasibility-preserving rounding
+    pass; monotone per row, so the rounding argmax ranks by plan mass.
+    On uniform workloads the plan ties across equal columns and the
+    rounding degenerates to first-fit — which is exactly the packing
+    behavior the occupied-fragmentation metric rewards.
+    """
+    a = row_counts.astype(jnp.float32)
+    b = jnp.maximum(col_cap.astype(jnp.float32), 0.0)
+    eps = jnp.float32(1e-12)
+    n_iters = jnp.maximum(iters, 1)
+    stages = jnp.int32(SINKHORN_STAGES)
+    kmask = feasible.astype(jnp.float32)
+    # Row-relative costs: subtract each row's feasible max so exp() is
+    # bounded in (0, 1] and `temp` is scale-free.
+    rmax = jnp.max(jnp.where(feasible, cost.astype(jnp.float32), NEG_INF),
+                   axis=1, keepdims=True)
+    sc = jnp.where(feasible, cost.astype(jnp.float32) - rmax, 0.0)
+
+    def kernel(stage):
+        t = temp * jnp.exp2((stages - 1 - stage).astype(jnp.float32))
+        return kmask * jnp.exp(sc / jnp.maximum(t, eps))
+
+    def step(i, uv):
+        u, v = uv
+        k = kernel(jnp.minimum((stages * i) // n_iters, stages - 1))
+        u = a / jnp.maximum(k @ v, eps)
+        col = u @ k
+        v = jnp.minimum(jnp.float32(1.0), b / jnp.maximum(col, eps))
+        return (u, v)
+
+    u, v = lax.fori_loop(
+        0, n_iters, step,
+        (jnp.ones(a.shape, jnp.float32), jnp.ones(b.shape, jnp.float32)))
+    plan = u[:, None] * kernel(stages - 1) * v[None, :]
+    log_plan = jnp.log(plan + jnp.float32(1e-30))
+    log_plan = jnp.where(jnp.isfinite(log_plan) & feasible, log_plan,
+                         jnp.float32(-1e30))
+    return log_plan, plan
+
+
+@jax.jit
+def consolidation_scores(free_q, alloc_q, used_pods, valid, threshold):
+    """Per-node consolidation priority for the descheduler, scored from
+    the same resident device planes the solver consumes: occupied nodes
+    whose mean free-capacity fraction is ≥ `threshold` are drain
+    candidates, scored by emptiness (emptiest first — draining the node
+    with the least to move frees a whole node soonest). Empty nodes,
+    invalid rows, and well-packed nodes score NEG_INF (never drained)."""
+    alloc = alloc_q.astype(jnp.float32)
+    frac = jnp.where(alloc > 0, free_q.astype(jnp.float32) / alloc, 0.0)
+    per_node = jnp.sum(frac, axis=1) / jnp.maximum(
+        jnp.sum(alloc > 0, axis=1), 1)
+    eligible = valid & (used_pods > 0) & (per_node >= threshold)
+    return jnp.where(eligible, per_node, NEG_INF)

@@ -248,8 +248,16 @@ class FaultInjector:
             rec["recovered"] = True
             return
         start = int(ev.params.get("offset", 0)) % len(bound)
-        victims = [bound[(start + i) % len(bound)]
-                   for i in range(min(count, len(bound)))]
+        # stride > 1 scatters the victims across the sorted bound set —
+        # name order tracks packing order, so a strided slice punches
+        # holes in MANY nodes instead of emptying a contiguous few. With
+        # replace=false that is the descheduler's adversary: a canceled
+        # rollout (scale-down) stranding survivors on half-empty nodes
+        # that arrival-order placement never revisits.
+        stride = max(1, int(ev.params.get("stride", 1)))
+        victims = list(dict.fromkeys(
+            bound[(start + i * stride) % len(bound)]
+            for i in range(min(count, len(bound)))))
         t0 = self.clock()
         rec["displaced_pods"] = len(victims)
         for key in victims:
@@ -258,6 +266,10 @@ class FaultInjector:
                 self.net_created -= 1
             except StoreError:
                 pass
+        if not ev.params.get("replace", True):
+            rec["replacements"] = 0
+            rec["recovered"] = True
+            return
         tmpl = {**self.pod_template,
                 "labels": {**(self.pod_template.get("labels") or {}),
                            "rollout": f"wave-{round(ev.at * 1e3)}"}}

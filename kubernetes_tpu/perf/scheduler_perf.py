@@ -215,6 +215,21 @@ class WorkloadResult:
         self.churn_faults: list[dict] = []
         self.churn_faults_injected: dict[str, int] = {}
         self.churn_recovery_seconds_max: float | None = None
+        #: r20 global-assignment accounting: OCCUPIED-node fragmentation
+        #: (the optimizable packing metric — the all-nodes figure above
+        #: is placement-invariant once every pod places), optimal-mode
+        #: solve vs greedy-degrade chunk counts over the measured phase,
+        #: and the ChurnDay rebalance family's outputs — a
+        #: [t_s, frag_pct, frag_occupied_pct] curve sampled through the
+        #: churn window, descheduler evict-and-replace moves, and the
+        #: post-churn backlog-drain recovery wall (descheduler runs
+        #: only).
+        self.fragmentation_occupied_pct = 0.0
+        self.solver_optimal_solves_total = 0
+        self.solver_optimal_fallbacks_total = 0
+        self.churn_fragmentation_curve: list[list[float]] = []
+        self.churn_descheduler_evictions = 0
+        self.churn_rebalance_recovery_s: float | None = None
 
     def as_dict(self) -> dict:
         import math
@@ -232,6 +247,8 @@ class WorkloadResult:
             "attempt_p999_ms": ms(self.attempt_p999),
             "attempt_percentiles_exact": self.attempt_percentiles_exact,
             "fragmentation_pct": round(self.fragmentation_pct, 2),
+            "fragmentation_occupied_pct": round(
+                self.fragmentation_occupied_pct, 2),
             "scheduled_total": self.scheduled_total,
             "unschedulable_total": self.unschedulable_total,
             "events_dropped_total": self.events_dropped_total,
@@ -279,6 +296,9 @@ class WorkloadResult:
                    + self.solver_wave_replays_total), 2)
             if (self.solver_wave_commits_total
                 + self.solver_wave_replays_total) else None,
+            "solver_optimal_solves_total": self.solver_optimal_solves_total,
+            "solver_optimal_fallbacks_total":
+                self.solver_optimal_fallbacks_total,
             "prep_seconds_total": round(self.prep_seconds_total, 3),
             "plane_classes_per_chunk": self.plane_classes_per_chunk,
             "plane_bytes_uploaded_total": self.plane_bytes_uploaded_total,
@@ -315,6 +335,10 @@ class WorkloadResult:
             "churn_faults": list(self.churn_faults),
             "churn_faults_injected": dict(self.churn_faults_injected),
             "churn_recovery_seconds_max": self.churn_recovery_seconds_max,
+            "churn_fragmentation_curve": [
+                list(s) for s in self.churn_fragmentation_curve],
+            "churn_descheduler_evictions": self.churn_descheduler_evictions,
+            "churn_rebalance_recovery_s": self.churn_rebalance_recovery_s,
         }
 
 
@@ -780,6 +804,9 @@ class PerfRunner:
         result.unschedulable_total = _result_count(metrics, "unschedulable")
         result.shard_count = int(getattr(backing, "node_shards", 1))
         result.fragmentation_pct = self._fragmentation(sched)
+        result.fragmentation_occupied_pct = \
+            self._fragmentation_occupied(sched)
+        metrics.fragmentation_pct.set(result.fragmentation_occupied_pct)
         result.events_emitted_total = sched.recorder.emitted
         result.events_dropped_total = sched.recorder.dropped
         return result
@@ -869,9 +896,52 @@ class PerfRunner:
                 await factory.informer("leases").wait_for_sync()
                 nlc.start()
 
+        # Rebalance family (r20): an optional descheduler closes the
+        # consolidation loop DURING the churn window, and a fragmentation
+        # sampler records the over-time curve the on/off pair compares.
+        # `descheduler: {enabled, period, budget, threshold}` on the op
+        # pins it per workload; absent, the KTPU_DESCHEDULER flag rules.
+        desch = None
+        dcfg = op.get("descheduler")
+        if dcfg is None:
+            from kubernetes_tpu.utils import flags as _flags
+            d_on = bool(_flags.get("KTPU_DESCHEDULER"))
+            dcfg = {}
+        else:
+            dcfg = {k: _subst(v, params) for k, v in dcfg.items()}
+            d_on = bool(dcfg.get("enabled", True))
+        if d_on:
+            from kubernetes_tpu.controllers.descheduler import (
+                DeschedulerController,
+            )
+            desch = DeschedulerController(
+                store,
+                period=float(dcfg.get("period", 0.25)),
+                budget=int(dcfg["budget"]) if "budget" in dcfg else None,
+                threshold=float(dcfg.get("threshold", 0.5)))
+            desch.setup(factory)
+            for res in ("pods", "nodes"):
+                factory.informer(res).start()
+                await factory.informer(res).wait_for_sync()
+            desch.start()
+
+        curve: list[list[float]] = []
+        sample_every = float(_subst(op.get("sampleInterval", 0.0), params))
+
+        async def _sample(t0: float) -> None:
+            while True:
+                curve.append([
+                    round(time.monotonic() - t0, 3),
+                    round(self._fragmentation(sched), 2),
+                    round(self._fragmentation_occupied(sched), 2)])
+                await asyncio.sleep(sample_every)
+
         window = self._begin_measure(metrics, backing) if measured else None
+        sampler = None
         try:
             t0 = time.monotonic()
+            if sample_every > 0:
+                sampler = asyncio.ensure_future(_sample(t0))
             inj_task = None
             if injector is not None:
                 inj_task = asyncio.ensure_future(
@@ -880,9 +950,36 @@ class PerfRunner:
             if inj_task is not None:
                 await inj_task
                 await injector.drain()
+            if desch is not None:
+                # Recovery: stop proposing moves, then the bounded wait
+                # for the backlog (evicted replacements included) to
+                # drain back under the threshold.
+                await desch.stop()
+                r0 = time.monotonic()
+                r_deadline = r0 + float(_subst(
+                    op.get("recoveryTimeout", 30.0), params))
+                thresh = int(_subst(op.get("recoveryThreshold", 10),
+                                    params))
+                while time.monotonic() < r_deadline \
+                        and sched.queue.backlog_depth() > thresh:
+                    await asyncio.sleep(0.05)
+                result.churn_rebalance_recovery_s = round(
+                    time.monotonic() - r0, 3)
         finally:
+            if sampler is not None:
+                sampler.cancel()
+                # one last point so the curve shows the recovered state
+                curve.append([
+                    round(time.monotonic() - t0, 3),
+                    round(self._fragmentation(sched), 2),
+                    round(self._fragmentation_occupied(sched), 2)])
+            if desch is not None:
+                if not desch._stopped:
+                    await desch.stop()
+                result.churn_descheduler_evictions = desch.evictions
             if nlc is not None:
                 await nlc.stop()
+        result.churn_fragmentation_curve = curve
         if measured:
             self._end_measure(result, metrics, backing, window,
                               phase.arrivals_total)
@@ -1093,6 +1190,8 @@ class PerfRunner:
             metrics.serving_coalesced_batches.value(),
             metrics.resident_plane_refreshes.value(),
             metrics.resident_plane_refresh.sum(),
+            metrics.solver_optimal_solves.value(),
+            metrics.solver_optimal_fallbacks.value(),
             metrics.attempt_window().mark())
 
     def _end_measure(self, result: WorkloadResult,
@@ -1107,6 +1206,7 @@ class PerfRunner:
          prep_s_base, plane_b_base, class_fb_base,
          shard_rb_base, shard_s_base, xshard_base,
          fast_base, coalesced_base, refresh_base, refresh_s_base,
+         opt_base, opt_fb_base,
          window_mark) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
@@ -1192,6 +1292,10 @@ class PerfRunner:
             metrics.resident_plane_refreshes.value() - refresh_base)
         result.resident_plane_refresh_seconds_total = \
             metrics.resident_plane_refresh.sum() - refresh_s_base
+        result.solver_optimal_solves_total = int(
+            metrics.solver_optimal_solves.value() - opt_base)
+        result.solver_optimal_fallbacks_total = int(
+            metrics.solver_optimal_fallbacks.value() - opt_fb_base)
         # Gauge is base-unit seconds now (metrics lint); the detail JSON
         # field keeps its ms name for report continuity.
         result.admission_window_ms = 1e3 * metrics.admission_window.value()
@@ -1232,6 +1336,28 @@ class PerfRunner:
                         max(0.0, (alloc - ni.requested.get(r)) / alloc))
             total += sum(fracs) / len(fracs) if fracs else 1.0
         return 100.0 * total / len(snapshot)
+
+    @staticmethod
+    def _fragmentation_occupied(sched: Scheduler) -> float:
+        """Mean free-capacity fraction across OCCUPIED nodes (%, the r20
+        packing metric — ops/solver.fragmentation_occupied's host twin):
+        the all-nodes figure is placement-invariant once every pod
+        places; this one drops when the same pods pack fewer, fuller
+        nodes. Empty cluster → 0.0."""
+        snapshot = sched.cache.update_snapshot()
+        total = 0.0
+        occupied = 0
+        for ni in snapshot:
+            if not ni.pods:
+                continue
+            occupied += 1
+            fracs = []
+            for r, alloc in ni.allocatable.res.items():
+                if alloc > 0:
+                    fracs.append(
+                        max(0.0, (alloc - ni.requested.get(r)) / alloc))
+            total += sum(fracs) / len(fracs) if fracs else 1.0
+        return 100.0 * total / occupied if occupied else 0.0
 
 
 def _result_count(metrics: SchedulerMetrics, result: str) -> int:

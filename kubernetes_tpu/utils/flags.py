@@ -58,6 +58,13 @@ def _parse_str(raw: str) -> str:
     return raw
 
 
+def _parse_solve_mode(raw: str) -> str:
+    v = raw.strip().lower()
+    if v not in ("greedy", "optimal", "auto"):
+        raise ValueError(raw)  # degrades to the default, per read()
+    return v
+
+
 @dataclass(frozen=True)
 class Flag:
     name: str
@@ -104,6 +111,29 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
           "Wavefront width override (pods evaluated per scan step). "
           "Unset = the AdaptiveTuner policy row picks W and shrinks it "
           "when the measured replay fraction climbs."),
+    _flag("KTPU_SOLVE_MODE", "auto", _parse_solve_mode,
+          "Batch solve mode: `greedy` pins the r18 wavefront scan call "
+          "graph (bit-identical assignments — the kill switch), "
+          "`optimal` forces the device-side Sinkhorn transport plan + "
+          "feasible rounding for every eligible chunk, `auto` routes "
+          "drain-scale and gang chunks to optimal per the tuner policy "
+          "row (serving single-pod traffic never routes here).",
+          kill_switch=True),
+    _flag("KTPU_SINKHORN_ITERS", 24, _parse_int,
+          "Sinkhorn iterations per optimal-mode chunk (the temperature "
+          "annealing's 3 stages split this count)."),
+    _flag("KTPU_SINKHORN_TEMP", 0.05, _parse_float,
+          "Final Sinkhorn temperature (entropic regularization weight "
+          "on the row-normalized cost) — annealing runs 4x -> 2x -> 1x "
+          "this value; lower = sharper, closer-to-argmax plans."),
+    _flag("KTPU_DESCHEDULER", False, _parse_bool,
+          "Default-enable the rebalance descheduler "
+          "(controllers/descheduler.py) in ChurnDay scenarios that "
+          "don't pin it: periodic evict-and-replace consolidation "
+          "moves scored from the resident device planes."),
+    _flag("KTPU_DESCHEDULER_BUDGET", 8, _parse_int,
+          "Disruption budget: max evict-and-replace moves the "
+          "descheduler may issue per sync cycle."),
     _flag("KTPU_WATCH_CACHE", True, _parse_bool,
           "Watch-cache serving tier (store/cacher.py). `0` degrades "
           "every LIST/watch to the direct-mvcc path.", kill_switch=True),

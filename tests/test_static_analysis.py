@@ -269,7 +269,9 @@ class TestFlagRegistry:
         """Every flag: registered, documented, expected default — and
         NAMED here, which is what the FL304 'every flag has a test'
         check greps for: KTPU_SERVING, KTPU_CLASS_PLANES,
-        KTPU_WAVEFRONT, KTPU_WAVE_WIDTH, KTPU_WATCH_CACHE,
+        KTPU_WAVEFRONT, KTPU_WAVE_WIDTH, KTPU_SOLVE_MODE,
+        KTPU_SINKHORN_ITERS, KTPU_SINKHORN_TEMP, KTPU_DESCHEDULER,
+        KTPU_DESCHEDULER_BUDGET, KTPU_WATCH_CACHE,
         KTPU_POLICY_INDEX, KTPU_SHARDS,
         KTPU_SHARD_THRESHOLD, KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH,
         KTPU_SHORTLIST_K, KTPU_ADMISSION_WINDOW,
@@ -281,6 +283,11 @@ class TestFlagRegistry:
             "KTPU_CLASS_PLANES": True,
             "KTPU_WAVEFRONT": True,
             "KTPU_WAVE_WIDTH": None,
+            "KTPU_SOLVE_MODE": "auto",
+            "KTPU_SINKHORN_ITERS": 24,
+            "KTPU_SINKHORN_TEMP": 0.05,
+            "KTPU_DESCHEDULER": False,
+            "KTPU_DESCHEDULER_BUDGET": 8,
             "KTPU_WATCH_CACHE": True,
             "KTPU_POLICY_INDEX": True,
             "KTPU_SHARDS": None,
@@ -301,7 +308,8 @@ class TestFlagRegistry:
             assert flags.FLAGS[name].doc.strip(), name
         kills = {n for n, f in flags.FLAGS.items() if f.kill_switch}
         assert kills == {"KTPU_SERVING", "KTPU_CLASS_PLANES",
-                         "KTPU_WAVEFRONT", "KTPU_WATCH_CACHE",
+                         "KTPU_WAVEFRONT", "KTPU_SOLVE_MODE",
+                         "KTPU_WATCH_CACHE",
                          "KTPU_POLICY_INDEX", "KTPU_SHARDS"}
 
     def test_parse_behaviors(self, monkeypatch):
@@ -540,9 +548,20 @@ class TestTierOneGate:
                    "_wave_spec_picks", "_wave_conflicts"):
             assert qn in solver_reach, \
                 f"purity walk no longer reaches {qn}"
+        # The r20 optimal mode adds the Sinkhorn iteration body (a
+        # fori_loop callee under the jitted plan) in both the plain and
+        # the shard_map solvers — same anti-vacuity stake: a host sync
+        # inside the transport loop must stay visible to the gate.
+        assert "sinkhorn_plan" in solver_entries, \
+            "sinkhorn_plan not discovered as a jit entry"
+        assert "sinkhorn_plan.step" in solver_reach, \
+            "purity walk no longer reaches the Sinkhorn iteration body"
         sharded_reach = {qn for rel, qn in reach
                          if rel == "kubernetes_tpu/parallel/sharded.py"}
         assert any(qn.endswith("_wave_body.wave_step")
                    for qn in sharded_reach), \
             "purity walk no longer reaches the sharded wave body"
+        assert any(qn.endswith("sink_run.step")
+                   for qn in sharded_reach), \
+            "purity walk no longer reaches the sharded Sinkhorn body"
         assert len(reach) >= 20
