@@ -25,6 +25,18 @@ import sys
 
 REFERENCE_PODS_PER_SEC = 300.0
 
+
+def _provenance(backend: str) -> dict:
+    """Solve-backend provenance stamped into every headline/detail JSON:
+    the jax platform and device count the run actually used, and whether
+    the solve routed through the fused Pallas kernel, the lax.scan
+    reference, and the donated carry — a relay-battery number is only
+    comparable to a CPU one when both rows carry these fields."""
+    if backend != "tpu":
+        return {"solve_kernel": "host"}
+    from kubernetes_tpu.ops.backend import solve_provenance
+    return solve_provenance()
+
 #: default --churn rate sweeps (pods/s arrival): bracket the knee from
 #: a comfortable trickle to past the drain headline for the preset.
 PRESET_CHURN_RATES = {
@@ -108,11 +120,14 @@ def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
         warmup=args.churn_warmup, agents=args.churn_agents,
         fault=fault, fault_rate=args.churn_fault_rate,
         runner_factory=runner_factory, timeout=1800.0)
+    prov = _provenance(args.backend)
     print(json.dumps({"churn": sweep, "preset": args.preset,
-                      "backend": args.backend}), file=sys.stderr)
+                      "backend": args.backend,
+                      "provenance": prov}), file=sys.stderr)
     knee = sweep["knee"]
     value = knee["knee_rate"] or 0.0
     out = {
+        "provenance": prov,
         "metric": f"churn_knee_arrival_rate_{args.preset}_{args.backend}"
                   + (f"_apiserver_{args.transport}" if boundary else ""),
         "value": value,
@@ -180,9 +195,12 @@ def _run_serve(args, nodes: int, warmup: int, measured: int, shards,
                          "rate": args.serve_rate,
                          "duration": args.serve_duration}, timeout=1800.0))
     d, s = drain.as_dict(), serve.as_dict()
+    prov = _provenance(args.backend)
     print(json.dumps({"serve": s, "drain": d, "preset": args.preset,
-                      "backend": args.backend}), file=sys.stderr)
+                      "backend": args.backend,
+                      "provenance": prov}), file=sys.stderr)
     print(json.dumps({
+        "provenance": prov,
         "metric": f"serve_single_pod_p50_ms_{args.preset}_{args.backend}"
                   + (f"_apiserver_{args.transport}" if boundary else ""),
         "value": s["attempt_p50_ms"],
@@ -268,6 +286,16 @@ def main(argv=None) -> int:
                          "(the default policy) routes drain-scale and "
                          "gang chunks only. The r20 fragmentation pair "
                          "sweeps greedy vs optimal on one preset")
+    ap.add_argument("--pallas", choices=["auto", "on", "off"],
+                    default=None,
+                    help="KTPU_PALLAS: 'off' pins the r20 lax.scan call "
+                         "graph (bit-identical kill switch), 'on' forces "
+                         "the fused Pallas wavefront kernel (compiled "
+                         "where lowering exists, interpret elsewhere), "
+                         "'auto' (the default policy) compiles on "
+                         "accelerator backends only. The r21 relay "
+                         "battery sweeps off vs on per preset; the "
+                         "headline JSON stamps the resolved mode")
     ap.add_argument("--churn", action="store_true",
                     help="ChurnDay mode (perf/churn): instead of one "
                          "bulk drain, sweep an OPEN-LOOP Poisson/burst/"
@@ -379,6 +407,9 @@ def main(argv=None) -> int:
     if args.solve_mode is not None:
         import os
         os.environ["KTPU_SOLVE_MODE"] = args.solve_mode
+    if args.pallas is not None:
+        import os
+        os.environ["KTPU_PALLAS"] = args.pallas
     if args.class_pad is not None:
         import os
         if args.class_pad <= 0:
@@ -470,9 +501,12 @@ def main(argv=None) -> int:
               "https://ui.perfetto.dev)", file=sys.stderr)
 
     detail = res.as_dict()
+    prov = _provenance(args.backend)
     print(json.dumps({"detail": detail, "preset": args.preset,
-                      "backend": args.backend}, ), file=sys.stderr)
+                      "backend": args.backend,
+                      "provenance": prov}, ), file=sys.stderr)
     print(json.dumps({
+        "provenance": prov,
         "metric": f"pods_per_sec_{args.preset}_nodes_{args.backend}"
                   + (f"_apiserver_{args.transport}"
                      if args.through_apiserver else ""),

@@ -52,6 +52,7 @@ PASS_ID = "jit-purity"
 ENTRY_MODULE_SUFFIXES = (
     "kubernetes_tpu/ops/solver.py",
     "kubernetes_tpu/ops/kernels.py",
+    "kubernetes_tpu/ops/pallas_kernel.py",
     "kubernetes_tpu/ops/backend.py",
     "kubernetes_tpu/ops/affinity.py",
     "kubernetes_tpu/parallel/sharded.py",
@@ -64,7 +65,8 @@ _JIT_DECORATORS = ("jax.jit", "jit", "jax.vmap", "shard_map",
 _TRACE_WRAPPERS = ("lax.scan", "jax.lax.scan", "jax.vmap", "vmap",
                    "lax.cond", "jax.lax.cond", "jax.jit", "jit",
                    "shard_map", "lax.while_loop", "jax.lax.while_loop",
-                   "lax.fori_loop", "jax.checkpoint", "jax.remat")
+                   "lax.fori_loop", "jax.checkpoint", "jax.remat",
+                   "pl.pallas_call", "pallas_call")
 
 _HOST_SYNC_ATTRS = ("item", "tolist", "block_until_ready")
 _HOST_SYNC_CALLS = ("np.asarray", "numpy.asarray", "np.array",

@@ -22,6 +22,14 @@ logger = logging.getLogger(__name__)
 _seq = itertools.count(1)
 
 
+def _is_decade(n: int) -> bool:
+    """True at 1, 10, 100, 1000, ... — the buffer-full drop log fires
+    once per decade of drops per (source, reason)."""
+    while n >= 10 and n % 10 == 0:
+        n //= 10
+    return n == 1
+
+
 class _SpamFilter:
     """Per-(source, reason) token bucket (events_cache.go
     EventSourceObjectSpamFilter, keyed coarser: the reference keys by
@@ -116,6 +124,12 @@ class EventRecorder:
         #: drops attributable to the per-(source, reason) spam filter
         #: (a subset of `dropped`).
         self.spam_filtered = 0
+        #: buffer-full drops per (source component, reason), for log
+        #: rate limiting only — one warning per DECADE of drops per key
+        #: (1st, 10th, 100th, ...), so a storm of one reason can't bury
+        #: the first drop of another. The public counters above are the
+        #: accounting; this dict never feeds metrics.
+        self._full_drops_by_key: dict[tuple[str, str], int] = {}
 
     def event(self, obj: Mapping, event_type: str, reason: str, message: str) -> None:
         """Fire-and-forget, like the reference's buffered broadcaster."""
@@ -145,11 +159,19 @@ class EventRecorder:
                 self.dropped += 1  # the evicted event
             else:
                 self.dropped += 1
-                if self.dropped % 1000 == 1:
+                key = (self.component, reason)
+                n = self._full_drops_by_key.get(key, 0) + 1
+                self._full_drops_by_key[key] = n
+                # Log on the 1st, 10th, 100th, ... drop of each
+                # (source, reason) — a power-of-ten check, so the log
+                # volume is O(log drops) per key however hot the storm.
+                if _is_decade(n):
                     logger.warning(
                         "event buffer full (%d pending); dropped %d "
-                        "events so far (DropIfChannelFull)",
-                        len(self._pending), self.dropped)
+                        "%s/%s events (%d total) so far "
+                        "(DropIfChannelFull)",
+                        len(self._pending), n, self.component, reason,
+                        self.dropped)
                 return
         ev = new_object(
             "Event",

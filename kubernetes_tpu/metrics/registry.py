@@ -545,6 +545,22 @@ class SchedulerMetrics:
         self.solver_sinkhorn_iterations = r.gauge(
             "solver_sinkhorn_iterations",
             "Sinkhorn iteration budget of the latest optimal-mode solve")
+        #: Pallas fused-kernel observability: chunks whose wavefront
+        #: solve ran the fused kernel (interpret or compiled), and
+        #: chunks where the router WANTED the kernel (KTPU_PALLAS
+        #: resolved on) but fell back to the lax.scan reference — the
+        #: reason label separates structural shapes the kernel does not
+        #: fuse (spread/shortlist/optimal/wave_off/shape) from a
+        #: backend without a pallas lowering (unavailable). The kill
+        #: switch (KTPU_PALLAS=off) and the CPU auto default do NOT
+        #: count: off-by-policy is not a fallback.
+        self.solver_pallas_solves = r.counter(
+            "solver_pallas_solves_total",
+            "Chunks solved through the fused Pallas wavefront kernel")
+        self.solver_pallas_fallbacks = r.counter(
+            "solver_pallas_fallbacks_total",
+            "Chunks routed to the Pallas kernel that fell back to the "
+            "lax.scan reference", labels=("reason",))
         self.fragmentation_pct = r.gauge(
             "scheduler_fragmentation_pct",
             "Mean stranded-capacity fraction (pct) across occupied "

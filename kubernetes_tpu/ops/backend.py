@@ -66,7 +66,7 @@ import numpy as np
 from kubernetes_tpu.api.labels import ns_contains
 from kubernetes_tpu.utils import flags
 from kubernetes_tpu.utils.locking import check_dispatch_seam
-from kubernetes_tpu.ops import kernels, solver
+from kubernetes_tpu.ops import kernels, pallas_kernel, solver
 from kubernetes_tpu.ops.tensorize import ClusterTensors, PodBatch
 from kubernetes_tpu.scheduler.framework import (
     CycleState,
@@ -399,6 +399,41 @@ class AdaptiveTuner:
             return "greedy", False
         return ("optimal", False) if eligible else ("greedy", True)
 
+    def pallas_mode(self, wave_w: int, shortlist_k: int, spread: bool,
+                    solve_mode: str) -> tuple[str, str | None]:
+        """('off' | 'interpret' | 'compiled', fallback_reason) for one
+        chunk — the KTPU_PALLAS policy row. 'off' with reason None is
+        off BY POLICY (the kill switch, or `auto` on CPU where the scan
+        measured faster than the interpreter) and does not count as a
+        fallback; 'off' with a reason is a chunk the flag WANTED on the
+        kernel but whose shape the kernel does not fuse (spread /
+        shortlist / optimal keep their scans; wave_off is the W<=1
+        serial shape) or whose backend cannot lower it — those are the
+        `solver_pallas_fallbacks_total` rows. `auto` compiles on
+        accelerator backends only; `on` forces the kernel (compiled
+        when lowering is available, else interpret); `interpret` pins
+        the CPU tier-1 validation mode everywhere."""
+        raw = flags.get("KTPU_PALLAS")
+        if raw == "off":
+            return "off", None
+        compiled_ok = pallas_kernel.lowering_supported(
+            jax.default_backend())
+        if raw == "auto" and not compiled_ok:
+            return "off", None
+        if not pallas_kernel.is_available():
+            return "off", "unavailable"
+        if solve_mode != "greedy":
+            return "off", "optimal"
+        if spread:
+            return "off", "spread"
+        if shortlist_k:
+            return "off", "shortlist"
+        if wave_w <= 1:
+            return "off", "wave_off"
+        if raw == "interpret":
+            return "interpret", None
+        return ("compiled" if compiled_ok else "interpret"), None
+
     def wave_width(self, chunk: int) -> int:
         """Wavefront width for a chunk; 1 = degenerate one-member waves.
         The KTPU_WAVEFRONT kill switch is routed by the backend (it
@@ -597,7 +632,7 @@ def _solve_program():
             _SOLVE_PROGRAM = partial(
                 jax.jit,
                 static_argnames=("strategy", "use_spread", "shortlist_k",
-                                 "wave_w", "solve_mode"),
+                                 "wave_w", "solve_mode", "pallas"),
                 donate_argnums=(1,))(_mask_solve_update.__wrapped__)
     return _SOLVE_PROGRAM
 
@@ -606,6 +641,35 @@ def _donation_live() -> bool:
     """True when the fused program donates its carry (accelerator
     backends) — the resident seed must be copied exactly then."""
     return _solve_program() is not _mask_solve_update
+
+
+def solve_provenance() -> dict:
+    """Solve-backend provenance for bench/perf output: which jax
+    platform and device count produced a number, and whether the wave
+    solve routes pallas/scan and donates its carry — so CPU-jax rows
+    and relay rows can never be conflated in BASELINE again (the
+    BENCH_r05 attribution gap). Resolves the same policy the router
+    applies to an eligible greedy wave chunk; per-chunk structural
+    fallbacks can still keep individual chunks on the scan (counted in
+    solver_pallas_fallbacks_total)."""
+    platform = jax.default_backend()
+    raw = flags.get("KTPU_PALLAS")
+    if raw == "off":
+        resolved = "off"
+    elif raw == "interpret":
+        resolved = "interpret"
+    elif pallas_kernel.lowering_supported(platform):
+        resolved = "compiled"
+    else:
+        resolved = "interpret" if raw == "on" else "off"
+    return {
+        "jax_platform": platform,
+        "jax_device_count": jax.device_count(),
+        "solve_kernel": "scan" if resolved == "off" else "pallas",
+        "pallas_mode": resolved,
+        "pallas_flag": raw,
+        "carry_donation": _donation_live(),
+    }
 
 
 def _signature(plugin_name: str, pi: PodInfo) -> str:
@@ -625,7 +689,7 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
 
 @partial(jax.jit,
          static_argnames=("strategy", "use_spread", "shortlist_k",
-                          "wave_w", "solve_mode"))
+                          "wave_w", "solve_mode", "pallas"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        cls_idx, exc_col,
                        taint_f_mat, taint_p_mat, class_mask, class_scores,
@@ -636,7 +700,8 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        sp_applies, sp_contrib, perms, gang_onehot,
                        gang_required, sink_iters, sink_temp,
                        strategy: str, use_spread: bool, shortlist_k: int,
-                       wave_w: int, solve_mode: str = "greedy"):
+                       wave_w: int, solve_mode: str = "greedy",
+                       pallas: str = "off"):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -706,6 +771,16 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     together would multiply the replay conditions for a chunk shape the
     presets never hit. wave_w == 0 is the KTPU_WAVEFRONT kill-switch
     shape: the pre-wavefront call graph, structurally.
+
+    `pallas` ("off" | "interpret" | "compiled", static — part of the
+    fused-program key like the other routing statics) swaps the
+    wavefront scan for the FUSED PALLAS KERNEL (ops/pallas_kernel.py):
+    one grid step per wave with the carry resident, same op sequence,
+    bit-identical assignments. It only affects the plain wave branch
+    (greedy, non-spread, no shortlist) — every other shape keeps its
+    scan, and the router (_dispatch_chunk_jit) records those as counted
+    structural fallbacks rather than passing "on" here. "off" traces
+    the r20 scan call graph verbatim — the KTPU_PALLAS kill switch.
 
     `used_pack` is DONATED on accelerator backends (the _solve_program
     variant): the chunk chain is its only consumer — each dispatch
@@ -862,11 +937,23 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                 gang_required, sc0, cls_idx, sl_cand, sl_thresh, has_node,
                 rows=cls_idx, exc=exc_col)
         elif wave_w > 1:
-            assign, wave_com, wave_rep = solver.multistart_greedy_assign_wave(
-                req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
-                static_scores, fit_col_w, bal_col_mask, shape_u, shape_s,
-                w_fit, w_bal, strategy, wave_w, perms, gang_onehot,
-                gang_required, rows=cls_idx, exc=exc_col)
+            if pallas != "off":
+                assign, wave_com, wave_rep = \
+                    solver.multistart_greedy_assign_wave_pallas(
+                        req_q, req_nz_q, free_q, free_pods, used_nz_q,
+                        alloc_q, mask, static_scores, fit_col_w,
+                        bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                        strategy, wave_w, perms, gang_onehot,
+                        gang_required, rows=cls_idx, exc=exc_col,
+                        interpret=(pallas != "compiled"))
+            else:
+                assign, wave_com, wave_rep = \
+                    solver.multistart_greedy_assign_wave(
+                        req_q, req_nz_q, free_q, free_pods, used_nz_q,
+                        alloc_q, mask, static_scores, fit_col_w,
+                        bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                        strategy, wave_w, perms, gang_onehot,
+                        gang_required, rows=cls_idx, exc=exc_col)
         else:
             assign = solver.multistart_greedy_assign(
                 req_q, req_nz_q, free_q, free_pods, used_nz_q, alloc_q, mask,
@@ -2739,6 +2826,21 @@ class TPUBackend:
             prep["wave_w"] = 0
         prep["solve_mode"] = solve_mode
         prep["optimal_fallback"] = opt_fallback
+        # Pallas routing (the KTPU_PALLAS policy row + structural shape
+        # gate): the kernel fuses only the plain greedy wave branch, and
+        # holds the whole (C,N) planes + (W,N) evaluation resident per
+        # grid step — a chunk above the kernel's working-set ceiling
+        # keeps the scan, counted under reason="shape".
+        pallas_mode, pallas_fall = self._tuner.pallas_mode(
+            prep["wave_w"], prep["shortlist_k"], use_spread, solve_mode)
+        if pallas_mode != "off":
+            shape_reason = pallas_kernel.unsupported_reason(
+                ct.n_pad, prep["dev_mask"].shape[0],
+                ct.alloc_q.shape[1], prep["wave_w"])
+            if shape_reason is not None:
+                pallas_mode, pallas_fall = "off", shape_reason
+        prep["pallas_mode"] = pallas_mode
+        prep["pallas_fallback"] = pallas_fall
         if use_spread:
             sp_args = (sp["dev_dom"], sp["dev_cid"], sp["dev_counts"],
                        sp["dev_skew"], sp["dev_min_ok"], sp["dev_haskey"],
@@ -2760,7 +2862,7 @@ class TPUBackend:
                 np.int32(max(1, flags.get("KTPU_SINKHORN_ITERS"))),
                 np.float32(flags.get("KTPU_SINKHORN_TEMP")),
                 p["strategy"], use_spread, prep["shortlist_k"],
-                prep["wave_w"], solve_mode,
+                prep["wave_w"], solve_mode, pallas_mode,
             )
         self._dev_used = used_pack2
         if use_spread:
@@ -2817,6 +2919,16 @@ class TPUBackend:
                     max(1, flags.get("KTPU_SINKHORN_ITERS")))
             elif run.get("optimal_fallback"):
                 self.metrics.solver_optimal_fallbacks.inc()
+            # Pallas accounting: solves count chunks whose wave solve
+            # ran the fused kernel; fallbacks count chunks the flag
+            # wanted on the kernel but that kept the scan, labeled by
+            # why. Off-by-policy (kill switch, auto-on-CPU) records
+            # neither — the zero-counter degrade the smoke test pins.
+            if run.get("pallas_mode") not in (None, "off"):
+                self.metrics.solver_pallas_solves.inc()
+            elif run.get("pallas_fallback"):
+                self.metrics.solver_pallas_fallbacks.inc(
+                    reason=run["pallas_fallback"])
             if ctx.ct.prep_shards > 1:
                 # Sharded-path solve accounting: the fused program spans
                 # every shard, so the wall is labeled with the shard

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubernetes_tpu.ops import kernels
+from kubernetes_tpu.ops import kernels, pallas_kernel
 from kubernetes_tpu.parallel.mesh import NODES_AXIS, PODS_AXIS, SLICE_AXIS
 
 try:  # jax>=0.8 top-level; fall back for older versions
@@ -131,7 +131,7 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                           w_fit, w_bal, strategy: str,
                           shortlist_k: int = 0, rows=None, exc=None,
                           row_req_q=None, row_req_nz_q=None,
-                          wave_w: int = 0):
+                          wave_w: int = 0, pallas: bool = False):
     """Sequential-equivalent greedy with live re-scoring, node axis sharded.
 
     Per scan step: shard-local candidate (max score, min index among ties) →
@@ -149,6 +149,16 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
     O(1) scalars; what shrinks is each shard's local reduce, N/devices →
     K/devices + touched. A shard narrower than K+1 columns keeps the full
     local scan (nothing to prune).
+
+    pallas=True fuses each wave's shard-local (W, local_n) evaluation —
+    plane gather, exception gate, capacity fit, live re-score, feasible
+    masking — into one Pallas kernel per wave step
+    (ops/pallas_kernel.wave_eval). Everything that crosses the mesh is
+    UNCHANGED: the W pmax/pmin winner rounds, the global-coordinate
+    conflict OR-reduce, and the commit/replay cond stay in the shard_map
+    body (SURVEY §5.8's ICI reduction contract), so assignments remain
+    bit-identical at every shard count. The shortlist path keeps its
+    W=1 scan (shortlist_k wins when both are set), as before.
 
     Class-dictionary planes (the r14 format): `mask`/`static_scores` may
     carry C CLASS rows instead of P pod rows — pass `rows` ((P,) pod →
@@ -175,7 +185,8 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
     local_n = n_total // n_shards
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
     run = _solver_fn(mesh, strategy, local_n, shortlist_k=max(k, 0),
-                     wave_w=0 if k else max(0, wave_w))
+                     wave_w=0 if k else max(0, wave_w),
+                     pallas=bool(pallas and not k and wave_w > 1))
     p = req_q.shape[0]
     if rows is None:
         rows = jnp.arange(p, dtype=jnp.int32)
@@ -197,7 +208,7 @@ def _wave_body(mesh, axes, local_n, base, iota, strategy, wave_w,
                local_full, _reduce,
                req_q, req_nz_q, rows, exc, free_q, free_pods, used_nz,
                alloc_q, mask, static_sc, fit_col_w, bal_col_mask,
-               shape_u, shape_s, w_fit, w_bal):
+               shape_u, shape_s, w_fit, w_bal, pallas: bool = False):
     """The wavefront wave-step body of the sharded solver (traced inside
     the shard_map `run`; see sharded_greedy_assign's wave_w contract).
 
@@ -221,22 +232,34 @@ def _wave_body(mesh, axes, local_n, base, iota, strategy, wave_w,
     (req_w, req_nz_w, rows_w, ex_w), real_w, _ = _wave_split(
         W, (req_q, req_nz_q, rows, ex))
     w_iota = jnp.arange(W, dtype=jnp.int32)
+    interp = pallas_kernel.default_interpret() if pallas else True
 
     def wave_step(carry, inp):
         free_q, free_pods, used_nz = carry
         req, req_nz, row, e, real = inp
         el = e - base                                   # local exc coords
-        m = mask[row] \
-            & ((e < 0)[:, None] | (iota[None, :] == el[:, None])) \
-            & real[:, None]                             # (W, local_n)
-        fits = m & jnp.all(req[:, None, :] <= free_q[None, :, :], axis=-1) \
-            & (free_pods >= 1)[None, :]
-        sc = static_sc[row]
-        sc = sc + w_fit * kernels.fit_score(
-            alloc_q, used_nz, req_nz, fit_col_w, strategy, shape_u, shape_s)
-        sc = sc + w_bal * kernels.balanced_allocation_score(
-            alloc_q, used_nz, req_nz, bal_col_mask)
-        masked = jnp.where(fits, sc, -jnp.inf)
+        if pallas:
+            # Fused shard-local evaluation: same op sequence, one
+            # kernel — the inline form below is the bit-identical
+            # reference (tests/test_pallas_solver.py).
+            masked, m = pallas_kernel.wave_eval(
+                mask, static_sc, alloc_q, free_q, free_pods, used_nz,
+                req, req_nz, row, e, el, real, fit_col_w, bal_col_mask,
+                shape_u, shape_s, w_fit, w_bal, strategy,
+                interpret=interp)
+        else:
+            m = mask[row] \
+                & ((e < 0)[:, None] | (iota[None, :] == el[:, None])) \
+                & real[:, None]                         # (W, local_n)
+            fits = m & jnp.all(req[:, None, :] <= free_q[None, :, :],
+                               axis=-1) & (free_pods >= 1)[None, :]
+            sc = static_sc[row]
+            sc = sc + w_fit * kernels.fit_score(
+                alloc_q, used_nz, req_nz, fit_col_w, strategy, shape_u,
+                shape_s)
+            sc = sc + w_bal * kernels.balanced_allocation_score(
+                alloc_q, used_nz, req_nz, bal_col_mask)
+            masked = jnp.where(fits, sc, -jnp.inf)
         # Prefix-distinct GLOBAL picks: per member, one local max with
         # earlier picks masked out (owner shard), then the serial step's
         # pmax/pmin winner reduction.
@@ -330,7 +353,8 @@ def _wave_body(mesh, axes, local_n, base, iota, strategy, wave_w,
 
 def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
                axes: tuple[str, ...] = (NODES_AXIS,),
-               shortlist_k: int = 0, wave_w: int = 0):
+               shortlist_k: int = 0, wave_w: int = 0,
+               pallas: bool = False):
     """One solver body for every mesh shape: the node dimension shards over
     `axes` (flattened, first axis major). Reductions run innermost-axis
     first, so a (slice, nodes) pair reduces slice-locally over ICI before
@@ -338,7 +362,7 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
     §5.7 falls out of the axis order. wave_w > 1 compiles the wavefront
     wave-step body instead of the one-pod step (mutually exclusive with
     shortlist_k; the caller routes)."""
-    key = (mesh, strategy, local_n, axes, shortlist_k, wave_w)
+    key = (mesh, strategy, local_n, axes, shortlist_k, wave_w, pallas)
     fn = _SOLVER_CACHE.get(key)
     if fn is not None:
         return fn
@@ -394,7 +418,7 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
                 local_full, _reduce,
                 req_q, req_nz_q, rows, exc, free_q, free_pods, used_nz,
                 alloc_q, mask, static_sc, fit_col_w, bal_col_mask,
-                shape_u, shape_s, w_fit, w_bal)
+                shape_u, shape_s, w_fit, w_bal, pallas=pallas)
 
         if shortlist_k:
             # Shard-local prefilter: chunk-start scores over MY columns,
@@ -636,7 +660,8 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
                                      strategy: str, shortlist_k: int = 0,
                                      rows=None, exc=None,
                                      row_req_q=None, row_req_nz_q=None,
-                                     wave_w: int = 0):
+                                     wave_w: int = 0,
+                                     pallas: bool = False):
     """Sequential-equivalent greedy over a (slice × nodes) mesh: the same
     solver body as `sharded_greedy_assign`, with the node dimension sharded
     over BOTH axes and the per-step argmax reduced hierarchically —
@@ -654,7 +679,8 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
     run = _solver_fn(mesh, strategy, local_n,
                      axes=(SLICE_AXIS, NODES_AXIS), shortlist_k=max(k, 0),
-                     wave_w=0 if k else max(0, wave_w))
+                     wave_w=0 if k else max(0, wave_w),
+                     pallas=bool(pallas and not k and wave_w > 1))
     p = req_q.shape[0]
     if rows is None:
         rows = jnp.arange(p, dtype=jnp.int32)

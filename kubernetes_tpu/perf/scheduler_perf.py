@@ -156,6 +156,15 @@ class WorkloadResult:
         self.solver_wave_width = 0
         self.solver_wave_commits_total = 0
         self.solver_wave_replays_total = 0
+        #: Fused Pallas wavefront kernel accounting (r21): chunks solved
+        #: through ops/pallas_kernel.py vs chunks that requested the
+        #: kernel and fell back to the lax.scan reference, plus the
+        #: solve-backend provenance row (jax platform, device count,
+        #: resolved pallas mode, carry donation) stamped per family so a
+        #: relay row and a CPU row are never mistaken for each other.
+        self.solver_pallas_solves_total = 0
+        self.solver_pallas_fallbacks_total = 0
+        self.solve_provenance: dict = {}
         #: Class-dictionary device-plane accounting over the measured
         #: phase (r14): host-side chunk-prep wall (the prep-vs-solve
         #: split per family), equivalence classes behind the latest
@@ -296,6 +305,10 @@ class WorkloadResult:
                    + self.solver_wave_replays_total), 2)
             if (self.solver_wave_commits_total
                 + self.solver_wave_replays_total) else None,
+            "solver_pallas_solves_total": self.solver_pallas_solves_total,
+            "solver_pallas_fallbacks_total":
+                self.solver_pallas_fallbacks_total,
+            "solve_provenance": self.solve_provenance,
             "solver_optimal_solves_total": self.solver_optimal_solves_total,
             "solver_optimal_fallbacks_total":
                 self.solver_optimal_fallbacks_total,
@@ -1180,6 +1193,8 @@ class PerfRunner:
             metrics.solver_shortlist_fallbacks.value(),
             metrics.solver_wave_commits.value(),
             metrics.solver_wave_replays.value(),
+            metrics.solver_pallas_solves.value(),
+            sum(metrics.solver_pallas_fallbacks._values.values()),
             metrics.prep_duration.sum(),
             metrics.plane_bytes.value(),
             metrics.class_split_fallbacks.value(),
@@ -1203,6 +1218,7 @@ class PerfRunner:
          audits_base, audit_drop_base,
          solve_chunks_base, solve_s_base, sl_pods_base,
          sl_fall_base, wave_com_base, wave_rep_base,
+         pallas_base, pallas_fb_base,
          prep_s_base, plane_b_base, class_fb_base,
          shard_rb_base, shard_s_base, xshard_base,
          fast_base, coalesced_base, refresh_base, refresh_s_base,
@@ -1268,6 +1284,14 @@ class PerfRunner:
             metrics.solver_wave_commits.value() - wave_com_base)
         result.solver_wave_replays_total = int(
             metrics.solver_wave_replays.value() - wave_rep_base)
+        result.solver_pallas_solves_total = int(
+            metrics.solver_pallas_solves.value() - pallas_base)
+        result.solver_pallas_fallbacks_total = int(
+            sum(metrics.solver_pallas_fallbacks._values.values())
+            - pallas_fb_base)
+        if self.backend is not None:
+            from kubernetes_tpu.ops.backend import solve_provenance
+            result.solve_provenance = solve_provenance()
         result.prep_seconds_total = \
             metrics.prep_duration.sum() - prep_s_base
         result.plane_classes_per_chunk = int(

@@ -65,6 +65,17 @@ def _parse_solve_mode(raw: str) -> str:
     return v
 
 
+def _parse_pallas(raw: str) -> str:
+    v = raw.strip().lower()
+    if v in ("auto", "on", "off", "interpret"):
+        return v
+    if v in _FALSE:          # the boolean spellings keep working
+        return "off"
+    if v in ("1", "true", "yes"):
+        return "on"
+    raise ValueError(raw)    # degrades to the default, per read()
+
+
 @dataclass(frozen=True)
 class Flag:
     name: str
@@ -107,6 +118,16 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
           "conflict replay). `0` degrades structurally to the "
           "one-pod-per-step W=1 scans, bit-identical assignments.",
           kill_switch=True),
+    _flag("KTPU_PALLAS", "auto", _parse_pallas,
+          "Fused Pallas wavefront solve kernel (ops/pallas_kernel.py). "
+          "`off` is the kill switch — the exact r20 lax.scan call graph, "
+          "bit-identical assignments. `auto` (default) compiles the "
+          "kernel on accelerator backends only and keeps the scan on "
+          "CPU; `on` forces the kernel (compiled when lowering is "
+          "available, else interpret); `interpret` forces the "
+          "interpreter everywhere (the CPU tier-1 validation mode). "
+          "Structural fallbacks to the scan are counted in "
+          "`solver_pallas_fallbacks_total`.", kill_switch=True),
     _flag("KTPU_WAVE_WIDTH", None, _parse_int,
           "Wavefront width override (pods evaluated per scan step). "
           "Unset = the AdaptiveTuner policy row picks W and shrinks it "

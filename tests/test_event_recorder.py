@@ -87,6 +87,36 @@ class TestAggregation:
             assert len(evs) == rec.MAX_PENDING
         run(body())
 
+    def test_buffer_full_log_one_line_per_decade(self, caplog):
+        """The buffer-full warning fires once per DECADE of drops per
+        (source, reason) — 1st, 10th, 100th, 1000th — so a retry storm
+        of one reason logs O(log n) lines and can't bury the first drop
+        of a different reason. The drop COUNTERS are untouched."""
+        import logging
+
+        async def body():
+            s = MVCCStore()
+            rec = EventRecorder(s, "scheduler")
+            rec.MAX_PENDING = 0        # every event hits the full path
+            rec.MAX_PENDING_PRIORITY = 0
+            rec._spam.allow = lambda *a: True  # isolate the full path
+            with caplog.at_level(logging.WARNING,
+                                 "kubernetes_tpu.client.events"):
+                for i in range(1500):
+                    rec.event(_pod(f"p{i}"), "Warning", "Evicted", "x")
+                lines = [r for r in caplog.records
+                         if "buffer full" in r.getMessage()]
+                assert len(lines) == 4  # drops 1, 10, 100, 1000
+                assert rec.dropped == 1500
+                # A second reason is not starved: its FIRST drop logs.
+                rec.event(_pod("q"), "Warning", "NodeLost", "x")
+                lines = [r for r in caplog.records
+                         if "buffer full" in r.getMessage()]
+                assert len(lines) == 5
+                assert "NodeLost" in lines[-1].getMessage()
+            assert rec.dropped == 1501
+        run(body())
+
 
 class TestPriorityAndSpam:
     def test_scheduled_burst_rides_the_deeper_priority_bound(self):

@@ -269,7 +269,7 @@ class TestFlagRegistry:
         """Every flag: registered, documented, expected default — and
         NAMED here, which is what the FL304 'every flag has a test'
         check greps for: KTPU_SERVING, KTPU_CLASS_PLANES,
-        KTPU_WAVEFRONT, KTPU_WAVE_WIDTH, KTPU_SOLVE_MODE,
+        KTPU_WAVEFRONT, KTPU_PALLAS, KTPU_WAVE_WIDTH, KTPU_SOLVE_MODE,
         KTPU_SINKHORN_ITERS, KTPU_SINKHORN_TEMP, KTPU_DESCHEDULER,
         KTPU_DESCHEDULER_BUDGET, KTPU_WATCH_CACHE,
         KTPU_POLICY_INDEX, KTPU_SHARDS,
@@ -282,6 +282,7 @@ class TestFlagRegistry:
             "KTPU_SERVING": True,
             "KTPU_CLASS_PLANES": True,
             "KTPU_WAVEFRONT": True,
+            "KTPU_PALLAS": "auto",
             "KTPU_WAVE_WIDTH": None,
             "KTPU_SOLVE_MODE": "auto",
             "KTPU_SINKHORN_ITERS": 24,
@@ -308,8 +309,8 @@ class TestFlagRegistry:
             assert flags.FLAGS[name].doc.strip(), name
         kills = {n for n, f in flags.FLAGS.items() if f.kill_switch}
         assert kills == {"KTPU_SERVING", "KTPU_CLASS_PLANES",
-                         "KTPU_WAVEFRONT", "KTPU_SOLVE_MODE",
-                         "KTPU_WATCH_CACHE",
+                         "KTPU_WAVEFRONT", "KTPU_PALLAS",
+                         "KTPU_SOLVE_MODE", "KTPU_WATCH_CACHE",
                          "KTPU_POLICY_INDEX", "KTPU_SHARDS"}
 
     def test_parse_behaviors(self, monkeypatch):
@@ -564,4 +565,22 @@ class TestTierOneGate:
         assert any(qn.endswith("sink_run.step")
                    for qn in sharded_reach), \
             "purity walk no longer reaches the sharded Sinkhorn body"
+        # The r21 fused Pallas kernel: pl.pallas_call is a trace
+        # wrapper, so the nested kernel BODIES (the grid-step solve and
+        # the shard-local wave eval, including the in-kernel conflict
+        # replay fori_loop) are entry points in their own right — a
+        # host sync inside a kernel body fails at runtime on real
+        # lowering, so it must stay visible to the gate here.
+        pallas_entries = entry_map["kubernetes_tpu/ops/pallas_kernel.py"]
+        for fn in ("wave_solve._wave_step_kernel",
+                   "wave_eval._wave_eval_kernel"):
+            assert fn in pallas_entries, \
+                f"pallas kernel body {fn} not discovered"
+        pallas_reach = {qn for rel, qn in reach
+                        if rel == "kubernetes_tpu/ops/pallas_kernel.py"}
+        assert "wave_solve._wave_step_kernel.slow.body" in pallas_reach, \
+            "purity walk no longer reaches the in-kernel replay body"
+        # The pallas entry wrappers in ops/solver.py are jit entries too.
+        assert "greedy_assign_rescoring_wave_pallas" in solver_entries
+        assert "multistart_greedy_assign_wave_pallas" in solver_entries
         assert len(reach) >= 20
