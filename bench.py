@@ -45,6 +45,7 @@ PRESET_CHURN_RATES = {
     "5k": [250.0, 1000.0, 4000.0],
     "50k": [250.0, 1000.0, 4000.0],
     "200k": [250.0, 1000.0, 4000.0],
+    "1m": [250.0, 1000.0, 4000.0],
 }
 
 def _warn_policy_needs_boundary(args, boundary, what: str) -> None:
@@ -75,7 +76,17 @@ PRESETS = {
     # the store/informer/host-prep path partitions into per-shard mvcc
     # stores (store/sharded.py) — flagless; --shards/KTPU_SHARDS override.
     "200k": (200000, 500, 5000),
+    # r22 stretch preset: 1M nodes. Intended for --processes >= 2 (the
+    # multi-process control plane); the finding — positive or negative,
+    # with the bounding resource named — is recorded in BASELINE.md.
+    "1m": (1_000_000, 500, 5000),
 }
+
+
+def _proc_tag(args) -> str:
+    """Metric-name suffix for multi-process rows: an N-process headline
+    must never be mistaken for (or averaged with) the in-process one."""
+    return f"_procs{args.processes}" if (args.processes or 0) > 1 else ""
 
 
 def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
@@ -112,7 +123,9 @@ def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
                           policy_count=args.policy_set,
                           policy_tenants=args.policy_tenants,
                           audit_rules=[{"level": args.audit_level}]
-                          if args.audit_level else None)
+                          if args.audit_level else None,
+                          processes=args.processes,
+                          data_dir=args.data_dir or None)
 
     sweep = run_rate_sweep(
         nodes=nodes, rates=rates, duration=args.churn_duration,
@@ -129,7 +142,8 @@ def _run_churn(args, nodes: int, shards, boundary, batch: int) -> int:
     out = {
         "provenance": prov,
         "metric": f"churn_knee_arrival_rate_{args.preset}_{args.backend}"
-                  + (f"_apiserver_{args.transport}" if boundary else ""),
+                  + (f"_apiserver_{args.transport}" if boundary else "")
+                  + _proc_tag(args),
         "value": value,
         "unit": "pods/s",
         "vs_baseline": round(value / REFERENCE_PODS_PER_SEC, 3),
@@ -169,7 +183,9 @@ def _run_serve(args, nodes: int, warmup: int, measured: int, shards,
                           policy_count=args.policy_set,
                           policy_tenants=args.policy_tenants,
                           audit_rules=[{"level": args.audit_level}]
-                          if args.audit_level else None)
+                          if args.audit_level else None,
+                          processes=args.processes,
+                          data_dir=args.data_dir or None)
 
     drain_template = [
         {"opcode": "createNodes", "countParam": "$nodes"},
@@ -202,7 +218,8 @@ def _run_serve(args, nodes: int, warmup: int, measured: int, shards,
     print(json.dumps({
         "provenance": prov,
         "metric": f"serve_single_pod_p50_ms_{args.preset}_{args.backend}"
-                  + (f"_apiserver_{args.transport}" if boundary else ""),
+                  + (f"_apiserver_{args.transport}" if boundary else "")
+                  + _proc_tag(args),
         "value": s["attempt_p50_ms"],
         "unit": "ms",
         "serve_rate": args.serve_rate,
@@ -241,6 +258,20 @@ def main(argv=None) -> int:
                          "KTPU_SHARD_THRESHOLD (100k) activate "
                          "KTPU_SHARDS or 8 shards; below it the r12 "
                          "single-store path runs bit-for-bit")
+    ap.add_argument("--processes", type=int, default=None,
+                    help="OVERRIDE the control-plane OS-process count "
+                         "(r22 tentpole): N >= 2 runs one apiserver "
+                         "process per shard plus a leader-elected "
+                         "scheduler pair over the KTPU wire; 1 is the "
+                         "kill switch (today's in-process tree, built "
+                         "exactly as before). Default: flagless "
+                         "KTPU_PROCESSES (unset = 1)")
+    ap.add_argument("--data-dir", default="",
+                    help="durability directory for the shard processes "
+                         "(per-shard snapshots + write-ahead log; "
+                         "KTPU_WAL_FSYNC picks the fsync policy). "
+                         "Default: flagless KTPU_DATA_DIR (unset = "
+                         "in-memory only)")
     ap.add_argument("--shortlist-k", type=int, default=None,
                     help="OVERRIDE the solver shortlist width (0 disables "
                          "the pruned solve — the before/after sweep knob). "
@@ -478,6 +509,10 @@ def main(argv=None) -> int:
     boundary = False
     if args.through_apiserver:
         boundary = "wire" if args.transport == "wire" else True
+    if (args.processes or 0) > 1 and (args.policy_set or args.audit_level):
+        print("warning: the multi-process control plane carries no "
+              "policy chain yet; --policy-set/--audit-level are ignored "
+              "at --processes >= 2", file=sys.stderr)
     if args.churn:
         return _run_churn(args, nodes, shards, boundary, batch)
     if args.serve:
@@ -491,8 +526,15 @@ def main(argv=None) -> int:
                         policy_tenants=args.policy_tenants,
                         audit_rules=[{"level": args.audit_level}]
                         if args.audit_level else None,
-                        shards=shards)
-    res = asyncio.run(runner.run(template, params, timeout=1800.0))
+                        shards=shards,
+                        processes=args.processes,
+                        data_dir=args.data_dir or None)
+    res = asyncio.run(runner.run(
+        template, params,
+        # The 1m stretch preset stages and syncs ~200x the 5k object
+        # count before the measured phase begins; everything else keeps
+        # the tighter window so a hung run fails fast.
+        timeout=5400.0 if args.preset == "1m" else 1800.0))
 
     if tracer is not None:
         with open(args.trace, "w") as f:
@@ -509,7 +551,8 @@ def main(argv=None) -> int:
         "provenance": prov,
         "metric": f"pods_per_sec_{args.preset}_nodes_{args.backend}"
                   + (f"_apiserver_{args.transport}"
-                     if args.through_apiserver else ""),
+                     if args.through_apiserver else "")
+                  + _proc_tag(args),
         "value": detail["throughput_pods_per_sec"],
         "unit": "pods/s",
         "vs_baseline": round(

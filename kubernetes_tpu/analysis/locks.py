@@ -38,6 +38,15 @@ queues are what this pass audits:
   held across them stalls every emitter. The runtime twin is the
   `check_dispatch_seam` guard in `policy/audit.py`'s `_write_batch` /
   webhook `_send`.
+- **LK207 process spawn/join under a lock**: `subprocess.run`/`Popen`/
+  `call`/`check_call`/`check_output`, `os.waitpid`/`os.fork`,
+  `multiprocessing.Process(...)`, or a `.start()`/`.join()`/`.wait()`/
+  `.terminate()`/`.kill()` on a process-ish receiver (`*proc*`,
+  `*process*`, `*child*`, `*worker*`) while holding any lock. Added for
+  the multi-process control plane (ISSUE r22): an interpreter spawn is
+  hundreds of milliseconds and a join is unbounded — either one under
+  the shared RV counter's lock (or any registry lock) stalls every
+  shard's write path.
 
 Lock identity is the attribute site (`module.Class.attr`); anything
 assigned from `threading.Lock/RLock/Condition`, `asyncio.Lock/
@@ -74,6 +83,13 @@ _SEND_ATTRS = ("sendall", "send_bytes", "drain")
 _SEND_CALLS = ("self.transport.write", "transport.write")
 _FILE_CALLS = ("open", "os.rename", "os.replace", "os.remove",
                "os.unlink")
+_PROC_CALLS = ("subprocess.run", "subprocess.Popen", "subprocess.call",
+               "subprocess.check_call", "subprocess.check_output",
+               "os.waitpid", "os.fork", "multiprocessing.Process")
+_PROC_ATTRS = ("start", "join", "wait", "terminate", "kill")
+#: receiver fragments that make a bare `.join()`/`.wait()` process-ish
+#: (so `",".join(...)` and `cond.wait()` never match).
+_PROC_RECEIVERS = ("proc", "process", "child", "worker")
 
 
 def _lockish_attr(name: str) -> bool:
@@ -348,6 +364,29 @@ def _check_held(mod, qn, node, held, cls_locks, findings):
                             f"{held_names} — disk latency stalls every "
                             "other holder (rotate/append outside the "
                             "lock)"))
+            elif n in _PROC_CALLS or _procish_call(sub):
+                findings.append(Finding(
+                    pass_id=PASS_ID, code="LK207", path=mod.rel,
+                    line=sub.lineno,
+                    symbol=f"{qn}:{n or sub.func.attr}",
+                    message=f"`{qn}` spawns or joins an OS process "
+                            f"while holding {held_names} — interpreter "
+                            "boot is ~100s of ms and a join is "
+                            "unbounded; every other holder stalls"))
+
+
+def _procish_call(call: ast.Call) -> bool:
+    """`<receiver>.start()/join()/wait()/terminate()/kill()` where the
+    dotted receiver names a process (`self._procs[i].join()`,
+    `worker.terminate()`); plain `",".join()` / `cond.wait()` don't."""
+    if not isinstance(call.func, ast.Attribute) \
+            or call.func.attr not in _PROC_ATTRS:
+        return False
+    recv = call.func.value
+    if isinstance(recv, ast.Subscript):
+        recv = recv.value
+    low = (dotted(recv) or "").lower()
+    return any(f in low for f in _PROC_RECEIVERS)
 
 
 def _written_attrs(body) -> set[str]:

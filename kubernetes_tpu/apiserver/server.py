@@ -218,7 +218,7 @@ class APIServer:
                  audit=None,
                  tracer=None,
                  data_dir: str | None = None,
-                 fsync: str = "batch"):
+                 fsync: str | None = None):
         #: Durability bootstrap (SURVEY §5.4, reachable END TO END — not
         #: just from tests): `data_dir` (or KTPU_DATA_DIR when no store
         #: is injected) recovers the newest snapshot + WAL tail on

@@ -688,6 +688,14 @@ class _Conn(asyncio.Protocol):
             return {"nodeShards": int(getattr(store, "node_shards", 1)),
                     "partitioned": list(
                         getattr(store, "partitioned_resources", ()))}
+        if op == "stats":
+            # Server-side observability snapshot: a shard process
+            # reports its WAL/durability counters (and anything else the
+            # host wired into stats_fn) so the parent can sum per-shard
+            # deltas into the bench detail JSON without scraping
+            # /metrics text.
+            fn = getattr(self.server, "stats_fn", None)
+            return dict(fn()) if fn is not None else {}
         raise ValueError(f"unknown op {op!r}")
 
     # -- watch push --------------------------------------------------------
@@ -810,6 +818,10 @@ class WireServer:
         #: APIServerMetrics shared with the HTTP server (for_apiserver):
         #: both wires report into one request-duration family.
         self.request_metrics = request_metrics
+        #: optional () -> dict for the `stats` op: a shard process wires
+        #: its WAL/durability counters here so the parent can pull
+        #: per-shard observability over the same socket.
+        self.stats_fn = None
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_Conn] = set()
         self._path = ""
@@ -1024,13 +1036,19 @@ class WireStore:
             self._connecting.set_result(None)
         except BaseException as e:
             # A refused handshake must not leave a half-open session that
-            # later calls would reuse unauthenticated.
+            # later calls would reuse unauthenticated. Transport-level
+            # connect failures (refused/absent socket during a shard
+            # restart window) surface as StoreError like every other
+            # wire failure — one error surface for retry loops.
+            if isinstance(e, OSError):
+                e = StoreError(f"wire connect failed: {e}")
             if self._proto is not None and self._proto.transport is not None:
                 self._proto.transport.close()
             self._proto = None
-            self._connecting.set_exception(e)
-            self._connecting = None
-            raise
+            fut, self._connecting = self._connecting, None
+            fut.set_exception(e)
+            fut.exception()  # retrieved: the creator raises below
+            raise e
         self._connecting = None
 
     def _conn_lost(self, exc) -> None:
@@ -1294,6 +1312,18 @@ class WireStore:
                                "unsharded server this time", exc_info=True)
                 return {"nodeShards": 1, "partitioned": []}
         return self._topology
+
+    async def control_stats(self) -> dict:
+        """Server-side observability snapshot (the `stats` op): the
+        shard process's WAL counters etc. Uncached — callers difference
+        snapshots around a measured phase. Servers predating the op
+        (or with no stats_fn wired) report {}."""
+        try:
+            return dict(await self._call("stats") or {})
+        except Exception:
+            logger.warning("stats probe failed; reporting empty",
+                           exc_info=True)
+            return {}
 
     async def refresh_discovery(self) -> None:
         resp = await self._call("kinds")

@@ -13,7 +13,7 @@ import time
 from typing import Awaitable, Callable
 
 from kubernetes_tpu.api.meta import new_object
-from kubernetes_tpu.store.mvcc import AlreadyExists, Conflict, MVCCStore, NotFound
+from kubernetes_tpu.store.mvcc import MVCCStore, NotFound, StoreError
 
 LEASES = "leases"
 
@@ -24,18 +24,34 @@ class LeaderElector:
         store: MVCCStore,
         lock_name: str,
         identity: str,
-        lease_duration: float = 15.0,
-        renew_deadline: float = 10.0,
-        retry_period: float = 2.0,
+        lease_duration: float | None = None,
+        renew_deadline: float | None = None,
+        retry_period: float | None = None,
         namespace: str = "kube-system",
+        metrics=None,
     ):
+        from kubernetes_tpu.utils import flags
         self.store = store
         self.lock_name = lock_name
         self.identity = identity
+        # KTPU_LEASE_DURATION scales the whole election clock: the
+        # renew deadline and retry period keep the reference's 15/10/2
+        # proportions unless pinned explicitly, so a short-lease test
+        # (or the failover bench row) tightens detection end to end.
+        if lease_duration is None:
+            lease_duration = float(flags.get("KTPU_LEASE_DURATION"))
         self.lease_duration = lease_duration
-        self.renew_deadline = renew_deadline
-        self.retry_period = retry_period
+        self.renew_deadline = (renew_deadline if renew_deadline
+                               is not None else lease_duration * (2 / 3))
+        self.retry_period = (retry_period if retry_period is not None
+                             else lease_duration * (2 / 15))
         self.namespace = namespace
+        #: HAMetrics (metrics/registry.py): elections won + the
+        #: is-leader gauge — failover is data, not log noise.
+        if metrics is None:
+            from kubernetes_tpu.metrics.registry import HAMetrics
+            metrics = HAMetrics()
+        self.metrics = metrics
         self.is_leader = False
 
     def _key(self) -> str:
@@ -55,8 +71,16 @@ class LeaderElector:
             try:
                 await self.store.create(LEASES, lease)
                 return True
-            except AlreadyExists:
+            except StoreError:  # AlreadyExists (lost race) or transient
                 return False
+        except StoreError:
+            # Transient store failure (the lease shard restarting, a
+            # wire blip): a FAILED ATTEMPT, retried on retry_period —
+            # client-go's tryAcquireOrRenew contract. Fencing still
+            # holds: the leader cancels its payload once renewals fail
+            # past renew_deadline; a replica must never crash out of
+            # the election because the apiserver bounced.
+            return False
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity")
         expired = now > spec.get("renewTime", 0) + spec.get(
@@ -78,7 +102,7 @@ class LeaderElector:
 
         try:
             updated = await self.store.guaranteed_update(LEASES, self._key(), mutate)
-        except Conflict:
+        except StoreError:  # Conflict (lost CAS race) or transient
             return False
         return updated.get("spec", {}).get("holderIdentity") == self.identity
 
@@ -92,6 +116,8 @@ class LeaderElector:
         while not await self._try_acquire_or_renew():
             await asyncio.sleep(self.retry_period)
         self.is_leader = True
+        self.metrics.elections.inc()
+        self.metrics.is_leader.set(1)
         payload = asyncio.ensure_future(on_started_leading())
         try:
             last_renew = time.time()
@@ -119,5 +145,6 @@ class LeaderElector:
                 except asyncio.CancelledError:
                     pass
             self.is_leader = False
+            self.metrics.is_leader.set(0)
             if on_stopped_leading:
                 on_stopped_leading()

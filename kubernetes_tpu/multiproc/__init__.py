@@ -1,0 +1,38 @@
+"""Multi-process control plane (ISSUE r22 tentpole).
+
+The in-process sharded store (store/sharded.py) divides the O(table)
+costs by S but every shard still shares one interpreter, one GIL, and
+one event loop. This package moves each shard into its own OS
+process behind the existing KTPU wire, the scheduler into a
+leader-elected active/standby pair, and the shared RVCounter into
+shared memory:
+
+- rv.py            — `SharedRVCounter`: atomic int64 in shared memory,
+                     monotonic setter (recovery can't regress RVs).
+- shardproc.py     — shard apiserver child: mvcc store + r12 cacher +
+                     per-shard WAL + wire socket.
+- schedproc.py     — scheduler replica child: Lease-elected leader
+                     rebuilds its assume-cache from informers.
+- client.py        — `ProcessShardedStore`: the MVCCStore-shaped
+                     facade routing over the shard sockets.
+- controlplane.py  — `MultiProcessControlPlane`: spawn/kill/restart
+                     supervisor + the measure-marker protocol.
+
+Activation: bench.py `--processes N` / KTPU_PROCESSES. `1` is the
+kill switch — the in-process tree is built exactly as before (no
+facade, no children), so degradation is structural.
+"""
+
+from kubernetes_tpu.multiproc.client import ProcessShardedStore
+from kubernetes_tpu.multiproc.controlplane import (
+    MeasureProtocol,
+    MultiProcessControlPlane,
+)
+from kubernetes_tpu.multiproc.rv import SharedRVCounter
+
+__all__ = [
+    "MeasureProtocol",
+    "MultiProcessControlPlane",
+    "ProcessShardedStore",
+    "SharedRVCounter",
+]

@@ -24,6 +24,13 @@ Kinds (performance-config.yaml `faults:` entries / bench --churn-fault):
 - gangArrival — create `count` pods AT ONCE from `podTemplate` (e.g.
   high-priority, colliding with the r6 preemption and r9 policy paths);
   recovery = the whole gang bound.
+- killLeader  — SIGKILL the ACTIVE scheduler process mid-wave
+  (multi-process runs only: needs the injector's `control_plane`
+  seam — multiproc/controlplane.py). The standby must win the lease
+  by EXPIRY, rebuild its assume-cache from fresh informer LISTs, and
+  resume; recovery = `count` canary pods created at kill time all
+  bound + backlog under threshold — the end-to-end failover
+  time-to-recovery the r22 ChurnDay row records.
 
 Each fault runs as its own task so recovery tracking never delays later
 timeline events; `churn_faults_injected_total{kind}` counts injections
@@ -102,6 +109,9 @@ def build_fault_timeline(specs: list[Mapping], seed: int = 0,
             params.setdefault("offset", rng.randrange(1 << 16))
         if kind == "gangArrival":
             params.setdefault("count", 8)
+        if kind == "killLeader":
+            # Canary pods probing scheduling liveness across failover.
+            params.setdefault("count", 8)
         events.append(FaultEvent(float(spec.get("at", 0.0)), kind, params))
     events.sort(key=lambda e: (e.at, e.kind))
     return events
@@ -124,7 +134,8 @@ class FaultInjector:
                  recovery_threshold: int = 10,
                  recovery_timeout: float = 60.0,
                  namespace: str = "default",
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 control_plane=None):
         self.store = store
         self.agents = {a.node_name: a for a in agents}
         self.bound_keys = bound_keys
@@ -136,6 +147,9 @@ class FaultInjector:
         self.recovery_timeout = float(recovery_timeout)
         self.namespace = namespace
         self.clock = clock
+        #: MultiProcessControlPlane (multiproc/) or None — the
+        #: killLeader seam; in-process runs have no leader to kill.
+        self.control_plane = control_plane
         #: one record per injected fault, timeline order:
         #: {kind, at, node?, displaced_pods, replacements, recovery_s,
         #:  recovered}
@@ -292,7 +306,30 @@ class FaultInjector:
             names, rec, t0,
             namespace=tmpl.get("namespace", self.namespace))
 
-    # -- shared mechanics --------------------------------------------------
+    async def _do_killLeader(self, ev: FaultEvent, rec: dict) -> None:
+        cp = self.control_plane
+        if cp is None:
+            logger.error("killLeader fault needs a multi-process run "
+                         "(--processes >= 2) — skipped")
+            rec["recovered"] = False
+            return
+        t0 = self.clock()
+        killed = await cp.kill_leader()
+        rec["leader"] = killed
+        if killed is None:
+            # No replica held the lease (already mid-election):
+            # nothing to kill, nothing to recover.
+            rec["recovered"] = False
+            return
+        # Canary gang created AT kill time: they can only bind once the
+        # standby holds the lease and has rebuilt its assume-cache, so
+        # their time-to-bound IS the failover time-to-recovery.
+        count = int(ev.params.get("count", 8))
+        names = [f"failover-{round(ev.at * 1e3)}-{i}"
+                 for i in range(count)]
+        await self._create_many(names, self.pod_template)
+        rec["replacements"] = count
+        await self._await_bound(names, rec, t0)
 
     async def _pods_on(self, node: str) -> list[dict]:
         try:

@@ -183,6 +183,17 @@ class WorkloadResult:
         self.shard_tensor_rebuilds_total = 0
         self.shard_solve_seconds = 0.0
         self.cross_shard_reductions_total = 0
+        #: Multi-process control plane accounting (r22 tentpole): OS
+        #: processes behind the run (1 = the classic in-process tree —
+        #: the structural-degrade witness), WAL appends / replayed
+        #: entries / fsync wall summed across the shard apiserver
+        #: processes, and scheduler leader elections observed (1 = the
+        #: initial acquisition; >1 means a failover happened mid-run).
+        self.process_count = 1
+        self.wal_appends_total = 0
+        self.wal_replay_entries_total = 0
+        self.wal_fsync_seconds_total = 0.0
+        self.leader_elections_total = 0
         #: Serving-tier accounting over the measured phase
         #: (kubernetes_tpu/serving, ROADMAP #3): lone pods placed
         #: through the pinned C=1 fast path, dispatches whose admission
@@ -323,6 +334,12 @@ class WorkloadResult:
             # reported as a (false) zero.
             "shard_solve_seconds": round(self.shard_solve_seconds, 6),
             "cross_shard_reductions_total": self.cross_shard_reductions_total,
+            "process_count": self.process_count,
+            "wal_appends_total": self.wal_appends_total,
+            "wal_replay_entries_total": self.wal_replay_entries_total,
+            "wal_fsync_seconds_total": round(
+                self.wal_fsync_seconds_total, 4),
+            "leader_elections_total": self.leader_elections_total,
             "serving_fast_path_pods_total": self.serving_fast_path_pods_total,
             "serving_coalesced_batches_total":
                 self.serving_coalesced_batches_total,
@@ -375,6 +392,66 @@ class _ServerPair:
         await self.api.stop()
 
 
+class _SchedulerProxy:
+    """Stands in for the in-process Scheduler when scheduling happens
+    in child processes (--processes >= 2): the harness keeps reading
+    the same seams — queue depth, event-recorder counters, the cache
+    snapshot — but the answers come from the parent's own pod informer
+    (backlog = pods without a nodeName) or are structurally empty (the
+    assume-cache lives in the leader replica; fragmentation over it is
+    reported as 0 here and the exact attempt percentiles come over the
+    measure-marker protocol instead)."""
+
+    class _Recorder:
+        emitted = 0
+        dropped = 0
+
+    class _Cache:
+        @staticmethod
+        def update_snapshot() -> list:
+            return []
+
+    def __init__(self):
+        self.queue = self
+        self.recorder = self._Recorder()
+        self.cache = self._Cache()
+        self._unbound: set[str] = set()
+
+    async def setup_informers(self, factory) -> None:
+        from kubernetes_tpu.client import ResourceEventHandler
+
+        def _upd(obj):
+            key = namespaced_name(obj)
+            if obj.get("spec", {}).get("nodeName"):
+                self._unbound.discard(key)
+            else:
+                self._unbound.add(key)
+
+        factory.informer("pods").add_event_handler(ResourceEventHandler(
+            on_add=_upd, on_update=lambda old, new: _upd(new),
+            on_delete=lambda obj: self._unbound.discard(
+                namespaced_name(obj))))
+
+    # -- queue surface (self.queue is self) --------------------------------
+
+    def stats(self) -> dict:
+        return {"active": len(self._unbound), "backoff": 0,
+                "unschedulable": 0, "gated": 0, "in_flight": 0}
+
+    def backlog_depth(self) -> int:
+        return len(self._unbound)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self, batch_size: int = 1) -> None:
+        # The replicas schedule; the proxy just holds the task slot the
+        # harness cancels on teardown.
+        await asyncio.Event().wait()
+
+    async def stop(self) -> None:
+        pass
+
+
 class PerfRunner:
     """Executes one workload (template ops + params) against an in-process
     store + scheduler, mirroring mustSetupCluster → runWorkload."""
@@ -387,13 +464,28 @@ class PerfRunner:
                  policy_count: int = 0,
                  policy_tenants: int = 0,
                  audit_rules: list | None = None,
-                 shards: int | None = None):
+                 shards: int | None = None,
+                 processes: int | None = None,
+                 data_dir: str | None = None):
         self.backend = backend
         self.batch_size = batch_size
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
         #: control-plane shard count for the backing store (>1 builds a
         #: ShardedNodeStore; None resolves KTPU_SHARDS, default 1).
         self.shards = shards
+        #: OS-process count for the control plane (r22 tentpole). >1
+        #: spawns one apiserver process per shard plus a leader-elected
+        #: scheduler pair and drives them through the cross-process
+        #: facade; None resolves KTPU_PROCESSES; <=1 builds today's
+        #: in-process tree exactly (nothing multiproc is constructed).
+        self.processes = processes
+        #: KTPU_DATA_DIR override for the shard processes (per-shard
+        #: snapshot + WAL directories live under it).
+        self.data_dir = data_dir
+        #: measure-marker protocol client, live only during a
+        #: multi-process run (see multiproc/controlplane.py).
+        self._mp = None
+        self._cp = None
         #: ValidatingAdmissionPolicies (+bindings) installed before the
         #: run — the policy-chain overhead knob (BASELINE r9: headline
         #: with a 10-policy set vs disabled). Only meaningful with
@@ -440,10 +532,52 @@ class PerfRunner:
     async def _run_inner(self, template_ops: list,
                          params: Mapping[str, Any],
                          timeout: float = 600.0) -> WorkloadResult:
-        backing = new_cluster_store(shards=self.shards)
-        install_core_validation(backing)
+        from kubernetes_tpu.utils import flags
+        nproc = self.processes
+        if nproc is None:
+            nproc = int(flags.get("KTPU_PROCESSES") or 1)
+        cp = None
+        self._mp = None
+        self._cp = None
         server = None
         client = None
+        if int(nproc) > 1:
+            # r22 tentpole topology: one apiserver OS process per shard
+            # plus a leader-elected scheduler pair; the parent only
+            # stages the workload and reads results through the
+            # cross-process facade. N<=1 takes the else branch and
+            # builds today's in-process tree exactly as before.
+            from kubernetes_tpu.multiproc import (
+                MeasureProtocol,
+                MultiProcessControlPlane,
+            )
+            backend_spec = None
+            if self.backend is not None:
+                backend_spec = {"kind": "tpu", "chunk": int(getattr(
+                    self.backend, "max_batch", 1) or 1)}
+            cp = MultiProcessControlPlane(
+                int(nproc),
+                data_dir=self.data_dir or flags.get("KTPU_DATA_DIR"),
+                backend_spec=backend_spec, batch_size=self.batch_size,
+                scheduler_kwargs=self.scheduler_kwargs)
+            try:
+                await cp.start()
+                await cp.start_schedulers(2)
+                store = backing = cp.client()
+                metrics = SchedulerMetrics()
+                sched = _SchedulerProxy()
+                factory = InformerFactory(store)
+                await sched.setup_informers(factory)
+                self._mp = MeasureProtocol(store)
+                self._cp = cp
+            except BaseException:
+                await cp.stop()
+                raise
+            return await self._drive(template_ops, params, timeout,
+                                     backing, store, metrics, sched,
+                                     factory, server, client, cp)
+        backing = new_cluster_store(shards=self.shards)
+        install_core_validation(backing)
         try:
             api_kw = {}
             if self.through_apiserver:
@@ -512,7 +646,15 @@ class PerfRunner:
                 await server.stop()
             backing.stop()
             raise
+        return await self._drive(template_ops, params, timeout, backing,
+                                 store, metrics, sched, factory, server,
+                                 client, None)
 
+    async def _drive(self, template_ops: list, params: Mapping[str, Any],
+                     timeout: float, backing, store, metrics, sched,
+                     factory, server, client, cp) -> WorkloadResult:
+        """The opcode loop, shared by both construction paths (`cp` is
+        the MultiProcessControlPlane for --processes >= 2, else None)."""
         # Bound-pod accounting via watch events, not store LISTs: a LIST
         # deep-copies every object and was the harness's own hot spot.
         bound_keys: set[str] = set()
@@ -641,6 +783,7 @@ class PerfRunner:
                         # throughput cover only the measured phase (warmup
                         # attempts — including jit compile — are excluded).
                         window = self._begin_measure(metrics, backing)
+                        await self._mp_begin()
                         if self.profile_dir and hasattr(
                                 self.backend, "start_profile"):
                             self.backend.start_profile(self.profile_dir)
@@ -701,6 +844,7 @@ class PerfRunner:
                         await self._wait_keys(bound_keys, want, deadline)
                         self._end_measure(result, metrics, backing,
                                           window, count)
+                        await self._mp_end(result)
                         if self.profile_dir and hasattr(
                                 self.backend, "stop_profile"):
                             self.backend.stop_profile()
@@ -713,6 +857,7 @@ class PerfRunner:
                     measured = bool(op.get("collectMetrics"))
                     if measured:
                         window = self._begin_measure(metrics, backing)
+                        await self._mp_begin()
                     gated = [p for p in (await store.list("pods")).items
                              if p["spec"].get("schedulingGates")]
 
@@ -727,6 +872,7 @@ class PerfRunner:
                                                deadline)
                         self._end_measure(result, metrics, backing,
                                           window, len(gated))
+                        await self._mp_end(result)
 
                 elif opcode == "relistStorm":
                     # Every agent reconnects AT ONCE: tear down its
@@ -798,23 +944,38 @@ class PerfRunner:
             await sched.stop()
             run_task.cancel()
             factory.stop()
+            if cp is not None:
+                # WAL/HA counters live in the children: pull them while
+                # the shard sockets still answer (best-effort on an
+                # exception path — the primary failure must surface).
+                try:
+                    await self._finalize_multiproc(result, backing)
+                except Exception:
+                    pass
             if client is not None:
                 await client.close()
             if server is not None:
                 await server.stop()
             backing.stop()
+            if cp is not None:
+                await cp.stop()
+                self._cp = None
+                self._mp = None
 
         # Percentiles were captured over the measured window above
         # (scheduler_scheduling_attempt_duration_seconds — SURVEY §5.5);
         # fall back to whole-run percentiles when no phase was measured.
-        if result.measured_pods == 0:
-            h = metrics.attempt_duration
-            labels = {"result": "scheduled", "profile": "default-scheduler"}
-            result.attempt_p50 = h.percentile(0.50, **labels)
-            result.attempt_p90 = h.percentile(0.90, **labels)
-            result.attempt_p99 = h.percentile(0.99, **labels)
-        result.scheduled_total = _result_count(metrics, "scheduled")
-        result.unschedulable_total = _result_count(metrics, "unschedulable")
+        if cp is None:
+            if result.measured_pods == 0:
+                h = metrics.attempt_duration
+                labels = {"result": "scheduled",
+                          "profile": "default-scheduler"}
+                result.attempt_p50 = h.percentile(0.50, **labels)
+                result.attempt_p90 = h.percentile(0.90, **labels)
+                result.attempt_p99 = h.percentile(0.99, **labels)
+            result.scheduled_total = _result_count(metrics, "scheduled")
+            result.unschedulable_total = _result_count(
+                metrics, "unschedulable")
         result.shard_count = int(getattr(backing, "node_shards", 1))
         result.fragmentation_pct = self._fragmentation(sched)
         result.fragmentation_occupied_pct = \
@@ -878,6 +1039,7 @@ class PerfRunner:
                 store=store, agents=agents, bound_keys=bound_keys,
                 create_pod=create_arrival,
                 backlog_fn=sched.queue.backlog_depth,
+                control_plane=self._cp,
                 metrics=churn_metrics, pod_template=tmpl,
                 recovery_threshold=int(_subst(
                     op.get("recoveryThreshold", 10), params)),
@@ -950,6 +1112,8 @@ class PerfRunner:
                 await asyncio.sleep(sample_every)
 
         window = self._begin_measure(metrics, backing) if measured else None
+        if measured:
+            await self._mp_begin()
         sampler = None
         try:
             t0 = time.monotonic()
@@ -996,6 +1160,7 @@ class PerfRunner:
         if measured:
             self._end_measure(result, metrics, backing, window,
                               phase.arrivals_total)
+            await self._mp_end(result)
         result.churn_offered_rate = phase.offered_rate
         result.churn_achieved_rate = phase.achieved_rate
         result.churn_arrival_model = phase.arrival_model
@@ -1174,6 +1339,58 @@ class PerfRunner:
         if cacher is None:
             return 0.0, 0.0
         return cacher.metrics.hits.value(), cacher.metrics.misses.value()
+
+    async def _mp_begin(self) -> None:
+        """Open the child-side measured window (multi-process runs
+        only): the leader marks its exact attempt recorder."""
+        if self._mp is not None:
+            await self._mp.begin()
+
+    async def _mp_end(self, result: WorkloadResult) -> None:
+        """Close the child-side window: the leader's exact attempt
+        percentiles override the parent's recorder (which never saw an
+        attempt — scheduling happened in another process). A failover
+        mid-window can eat the marker; the parent-side wall-clock
+        throughput from _end_measure then stands alone."""
+        if self._mp is None:
+            return
+        row = await self._mp.end()
+        import math
+        try:
+            pcts = {q: float(row[k]) for q, k in (
+                (0.50, "p50"), (0.90, "p90"),
+                (0.99, "p99"), (0.999, "p999"))}
+        except (KeyError, TypeError, ValueError):
+            return
+        if math.isnan(pcts[0.50]):
+            return
+        result.attempt_p50 = pcts[0.50]
+        result.attempt_p90 = pcts[0.90]
+        result.attempt_p99 = pcts[0.99]
+        result.attempt_p999 = pcts[0.999]
+        result.attempt_percentiles_exact = True
+
+    async def _finalize_multiproc(self, result: WorkloadResult,
+                                  backing) -> None:
+        """Pull the run's child-process counters (leader status row +
+        per-shard WAL stats) — must run BEFORE the control plane stops:
+        the sums live in the children, not the parent."""
+
+        def _i(v) -> int:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return 0
+
+        row = await self._mp.status()
+        result.process_count = int(backing.node_shards)
+        result.scheduled_total = _i(row.get("scheduledTotal"))
+        result.leader_elections_total = _i(row.get("elections"))
+        total = (await backing.control_stats()).get("total") or {}
+        result.wal_appends_total = _i(total.get("walAppends"))
+        result.wal_replay_entries_total = _i(total.get("walReplayed"))
+        result.wal_fsync_seconds_total = float(
+            total.get("walFsyncSeconds") or 0.0)
 
     def _begin_measure(self, metrics: SchedulerMetrics, backing) -> tuple:
         deg = metrics.backend_degradations

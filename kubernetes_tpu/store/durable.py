@@ -30,6 +30,7 @@ import json
 import logging
 import os
 import re
+import time
 from typing import Iterable
 
 from kubernetes_tpu.store.mvcc import Event, MVCCStore
@@ -44,10 +45,15 @@ class WriteAheadLog:
     """Append-only event log attached to a store via add_event_sink."""
 
     def __init__(self, store: MVCCStore, directory: str, *,
-                 fsync: str = "batch"):
+                 fsync: str | None = None, metrics=None):
+        from kubernetes_tpu.metrics.registry import DurabilityMetrics
+        from kubernetes_tpu.utils import flags
         self.store = store
         self.dir = directory
-        self.fsync = fsync
+        #: fsync policy: explicit argument wins, else KTPU_WAL_FSYNC
+        #: ("batch" group commit / "always" per-commit).
+        self.fsync = fsync or flags.get("KTPU_WAL_FSYNC")
+        self.metrics = metrics or DurabilityMetrics()
         os.makedirs(directory, exist_ok=True)
         self._base_rv = store.resource_version
         self._fh = open(self._wal_path(self._base_rv), "a",
@@ -57,7 +63,12 @@ class WriteAheadLog:
         #: (a HOLE in the log would be worse than a shorter durable
         #: prefix) and the health flag surfaces the degradation.
         self.broken = False
-        store.add_event_sink(self._on_event)
+        #: KTPU_WAL=0 structural kill switch: snapshot-only durability
+        #: (the r16 shape) — the sink never attaches, so commits cost
+        #: zero and recovery replays nothing between snapshots.
+        self.enabled = bool(flags.get("KTPU_WAL"))
+        if self.enabled:
+            store.add_event_sink(self._on_event)
 
     def _wal_path(self, base_rv: int) -> str:
         return os.path.join(self.dir, f"wal-{base_rv}.log")
@@ -81,13 +92,17 @@ class WriteAheadLog:
         try:
             self._fh.write(json.dumps(record, separators=(",", ":"))
                            + "\n")
+            self.metrics.appends.inc()
             if self.fsync == "always":
                 # Synchronous durability (the etcd posture): the commit
                 # is not acknowledged cheaper than the disk. "batch"
                 # trades a flush-interval durability window for keeping
                 # fsync off the commit path.
                 self._fh.flush()
+                t0 = time.perf_counter()
                 os.fsync(self._fh.fileno())
+                self.metrics.fsync_seconds.observe(
+                    time.perf_counter() - t0)
             else:
                 self._dirty = True
         except (OSError, ValueError, TypeError):
@@ -105,7 +120,10 @@ class WriteAheadLog:
         if self._dirty and not self.broken:
             try:
                 self._fh.flush()
+                t0 = time.perf_counter()
                 os.fsync(self._fh.fileno())
+                self.metrics.fsync_seconds.observe(
+                    time.perf_counter() - t0)
                 self._dirty = False
             except (OSError, ValueError):
                 self.broken = True
@@ -165,7 +183,10 @@ class WriteAheadLog:
     def _gc(self, keep_rv: int) -> None:
         for fn in os.listdir(self.dir):
             m = _SNAP_RE.match(fn) or _WAL_RE.match(fn)
-            if m and int(m.group(1)) < keep_rv:
+            # A crash between the tmp write and os.replace leaves a
+            # .tmp orphan; recovery never reads one (the name doesn't
+            # match), so reclaim it with the other obsolete files.
+            if fn.endswith(".tmp") or (m and int(m.group(1)) < keep_rv):
                 try:
                     os.unlink(os.path.join(self.dir, fn))
                 except OSError:
@@ -181,15 +202,23 @@ class DurabilityManager:
     """Owns the WAL + the periodic flush/snapshot loop for one store."""
 
     def __init__(self, store: MVCCStore, directory: str, *,
-                 fsync: str = "batch", flush_interval_s: float = 0.05,
+                 fsync: str | None = None, flush_interval_s: float = 0.05,
                  snapshot_interval_s: float = 30.0,
-                 snapshot_every_events: int = 100_000):
+                 snapshot_every_events: int = 100_000,
+                 metrics=None):
         self.store = store
-        self.wal = WriteAheadLog(store, directory, fsync=fsync)
+        self.wal = WriteAheadLog(store, directory, fsync=fsync,
+                                 metrics=metrics)
         self.flush_interval_s = flush_interval_s
         self.snapshot_interval_s = snapshot_interval_s
         self.snapshot_every_events = snapshot_every_events
         self._task: asyncio.Task | None = None
+        #: in-flight background write_snapshot (an executor future).
+        #: Cancelling _task mid-await does NOT stop the worker thread,
+        #: so stop() awaits this before its own final snapshot — two
+        #: writers interleaving segment rotation was the crash-corruption
+        #: window tests/test_durability.py pins closed.
+        self._snap_inflight = None
 
     def start(self) -> None:
         if self._task is None or self._task.done():
@@ -208,7 +237,10 @@ class DurabilityManager:
                 fd = self.wal.flush_to_os()
                 if fd is not None:
                     try:
+                        t0 = time.perf_counter()
                         await asyncio.to_thread(os.fsync, fd)
+                        self.wal.metrics.fsync_seconds.observe(
+                            time.perf_counter() - t0)
                     except OSError:
                         # Genuine sync failure (nothing rotates this fd
                         # concurrently — snapshot rotation runs later in
@@ -224,11 +256,25 @@ class DurabilityManager:
                         now - last_snap >= self.snapshot_interval_s
                         or log_span >= self.snapshot_every_events):
                     # Capture + rotate atomically on the loop; the disk
-                    # write runs in a worker thread. Idle clusters
-                    # (log_span 0) skip re-snapshotting identical state.
+                    # write runs in a worker thread. The executor future
+                    # is kept (not to_thread) so stop() can await the
+                    # thread even after cancelling this task. Idle
+                    # clusters (log_span 0) skip re-snapshotting
+                    # identical state.
                     data, rv = self.wal.begin_snapshot()
-                    await asyncio.to_thread(self.wal.write_snapshot,
-                                            data, rv)
+                    self._snap_inflight = \
+                        asyncio.get_running_loop().run_in_executor(
+                            None, self.wal.write_snapshot, data, rv)
+                    # shield: cancelling THIS task must detach the
+                    # awaiter, not cancel the future — a cancelled
+                    # wrapper is unawaitable while its worker thread
+                    # still writes, which is exactly what stop() needs
+                    # to wait out.
+                    await asyncio.shield(self._snap_inflight)
+                    # Cleared only AFTER a normal completion: a
+                    # cancellation mid-await leaves the reference for
+                    # stop() to drain.
+                    self._snap_inflight = None
                     last_snap = now
         except asyncio.CancelledError:
             return
@@ -241,6 +287,18 @@ class DurabilityManager:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # Serialize against a background write_snapshot whose worker
+        # thread survived the cancellation: letting the final snapshot
+        # below run concurrently with it interleaves two segment
+        # rotations + two _gc passes (the mid-snapshot corruption the
+        # crash-atomicity satellite exists to rule out).
+        inflight, self._snap_inflight = self._snap_inflight, None
+        if inflight is not None:
+            try:
+                await inflight
+            except Exception:
+                logger.exception(
+                    "background snapshot failed during stop")
         if final_snapshot:
             self.wal.snapshot()
         self.wal.close()
@@ -278,13 +336,18 @@ def _iter_wal(path: str) -> Iterable[
 
 
 def recover_store(directory: str,
-                  factory=None) -> MVCCStore:
+                  factory=None, *, rv_source=None,
+                  metrics=None) -> MVCCStore:
     """Rebuild a store from the newest snapshot + its WAL segment tail.
 
     `factory` (optional) builds the empty store when there is no
     snapshot — pass `new_cluster_store` to get validation/subresources
     installed; recovery with a snapshot uses MVCCStore.load then the
     caller re-installs hooks (install_core_validation is idempotent).
+    `rv_source` threads a shared RV counter into the rebuilt store (the
+    multi-process shard restart path: recovery must never regress the
+    live global counter). `metrics` (DurabilityMetrics) counts replayed
+    events into wal_replay_entries_total.
 
     Replayed events re-enter the watch ring: a watcher resuming with an
     rv newer than the snapshot base sees exactly the missed events; an
@@ -295,10 +358,13 @@ def recover_store(directory: str,
     if snaps:
         snap_rv, snap_path = snaps[-1]
         with open(snap_path, encoding="utf-8") as f:
-            store = MVCCStore.load(f.read())
+            store = MVCCStore.load(f.read(), rv_source=rv_source)
     else:
         snap_rv = 0
-        store = factory() if factory is not None else MVCCStore()
+        if factory is not None:
+            store = factory()
+        else:
+            store = MVCCStore(rv_source=rv_source)
     # Core subresources survive recovery (new_cluster_store parity).
     store.register_subresource("pods", "binding", binding_subresource)
     # Replay WAL segments based at or after the snapshot (older segments
@@ -308,7 +374,7 @@ def recover_store(directory: str,
             continue
         for rv, ev_type, resource, obj, prev_labels, prev_fields \
                 in _iter_wal(path):
-            if rv <= store.resource_version and rv <= snap_rv:
+            if rv <= snap_rv:
                 continue  # already inside the snapshot
             table = store._table(resource)
             key = store._key(obj)
@@ -316,7 +382,9 @@ def recover_store(directory: str,
                 table.pop(key, None)
             else:
                 table[key] = obj
-            store._rv = max(store._rv, rv)
+            store._rv = max(store.resource_version, rv)
+            if metrics is not None:
+                metrics.replayed.inc()
             store._events.append(
                 (resource, Event(ev_type, obj, rv, prev_labels,
                                  prev_fields)))

@@ -955,12 +955,19 @@ class MVCCStore:
         return json.dumps({"rv": self._rv, "tables": self._tables})
 
     @classmethod
-    def load(cls, data: str) -> "MVCCStore":
+    def load(cls, data: str,
+             rv_source: RVCounter | None = None) -> "MVCCStore":
+        """Rebuild from dump(). `rv_source` threads a shared counter
+        through recovery (the multi-process control plane's coordinated
+        RV scheme): a recovering shard adopts the LIVE global counter,
+        and the snapshot's rv only ever advances it — the counter's
+        monotonic setter means a restart can never hand out an rv the
+        cluster already moved past."""
         raw = json.loads(data)
-        store = cls()
+        store = cls(rv_source=rv_source)
         store._rv = raw["rv"]
         store._tables = raw["tables"]
-        store._first_retained_rv = store._rv + 1
+        store._first_retained_rv = raw["rv"] + 1
         return store
 
 

@@ -387,58 +387,9 @@ class ShardedNodeStore:
                    for s in self.shards]
         return self._multiplex(watches, bookmarks)
 
-    async def _multiplex(self, watches: list, bookmarks: bool
-                         ) -> AsyncIterator[Event]:
-        """Fan S shard streams into one. Per-key ordering is exact (a
-        key lives on one shard); cross-key ordering is arrival order
-        with globally-valid RVs."""
-        queue: asyncio.Queue = asyncio.Queue()
-        marks = [0] * len(watches)
-        sent_mark = 0
-        _END = object()  # per-pump end-of-stream sentinel
-
-        async def pump(i: int, w) -> None:
-            try:
-                async for ev in w:
-                    await queue.put((i, ev))
-                await queue.put((i, _END))
-            except Exception as e:
-                await queue.put((i, e))
-
-        tasks = [asyncio.ensure_future(pump(i, w))
-                 for i, w in enumerate(watches)]
-        live = len(watches)
-        try:
-            while live:
-                i, ev = await queue.get()
-                if ev is _END:
-                    # A shard's stream ended (store stopped): the merged
-                    # stream ends when every shard's has — matching the
-                    # single-store watch, which terminates on stop().
-                    live -= 1
-                    continue
-                if isinstance(ev, Exception):
-                    raise ev
-                if ev.type == "BOOKMARK":
-                    marks[i] = max(marks[i], ev.rv)
-                    low = min(marks)
-                    if bookmarks and low > sent_mark:
-                        sent_mark = low
-                        yield Event("BOOKMARK", {"metadata": {
-                            "resourceVersion": str(low)}}, low)
-                    continue
-                marks[i] = max(marks[i], ev.rv)
-                yield ev
-        finally:
-            for t in tasks:
-                t.cancel()
-            for w in watches:
-                aclose = getattr(w, "aclose", None)
-                if aclose is not None:
-                    try:
-                        await aclose()
-                    except Exception:
-                        pass
+    def _multiplex(self, watches: list, bookmarks: bool
+                   ) -> AsyncIterator[Event]:
+        return multiplex_watches(watches, bookmarks)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -453,6 +404,63 @@ class ShardedNodeStore:
             for r, t in s._tables.items():
                 tables.setdefault(r, {}).update(t)
         return json.dumps({"rv": self.resource_version, "tables": tables})
+
+
+async def multiplex_watches(watches: list, bookmarks: bool
+                            ) -> AsyncIterator[Event]:
+    """Fan S shard streams into one. Per-key ordering is exact (a
+    key lives on one shard); cross-key ordering is arrival order
+    with globally-valid RVs. Shared by the in-process facade above
+    and the cross-process one (multiproc/client.py) — merged
+    bookmarks advance at the MINIMUM of the per-shard bookmark RVs
+    in both."""
+    queue: asyncio.Queue = asyncio.Queue()
+    marks = [0] * len(watches)
+    sent_mark = 0
+    _END = object()  # per-pump end-of-stream sentinel
+
+    async def pump(i: int, w) -> None:
+        try:
+            async for ev in w:
+                await queue.put((i, ev))
+            await queue.put((i, _END))
+        except Exception as e:
+            await queue.put((i, e))
+
+    tasks = [asyncio.ensure_future(pump(i, w))
+             for i, w in enumerate(watches)]
+    live = len(watches)
+    try:
+        while live:
+            i, ev = await queue.get()
+            if ev is _END:
+                # A shard's stream ended (store stopped): the merged
+                # stream ends when every shard's has — matching the
+                # single-store watch, which terminates on stop().
+                live -= 1
+                continue
+            if isinstance(ev, Exception):
+                raise ev
+            if ev.type == "BOOKMARK":
+                marks[i] = max(marks[i], ev.rv)
+                low = min(marks)
+                if bookmarks and low > sent_mark:
+                    sent_mark = low
+                    yield Event("BOOKMARK", {"metadata": {
+                        "resourceVersion": str(low)}}, low)
+                continue
+            marks[i] = max(marks[i], ev.rv)
+            yield ev
+    finally:
+        for t in tasks:
+            t.cancel()
+        for w in watches:
+            aclose = getattr(w, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
 
 
 def _sort_key(obj: Mapping) -> str:

@@ -65,6 +65,13 @@ def _parse_solve_mode(raw: str) -> str:
     return v
 
 
+def _parse_wal_fsync(raw: str) -> str:
+    v = raw.strip().lower()
+    if v not in ("batch", "always"):
+        raise ValueError(raw)  # degrades to the default, per read()
+    return v
+
+
 def _parse_pallas(raw: str) -> str:
     v = raw.strip().lower()
     if v in ("auto", "on", "off", "interpret"):
@@ -171,6 +178,29 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
     _flag("KTPU_SHARD_THRESHOLD", 100_000, _parse_int,
           "Node count at which the flagless shard policy switches from "
           "1 shard to 8 (store/sharded.control_plane_shards)."),
+    _flag("KTPU_PROCESSES", None, _parse_int,
+          "Control-plane OS-process count (multiproc/): each store "
+          "shard becomes its own apiserver process on a unix-socket "
+          "KTPU wire, the scheduler an active/standby process pair. "
+          "`1` is the kill switch — the classic in-process tree, "
+          "bit-identical call graph. Unset = in-process (the bench's "
+          "--processes flag is the spawn path).", kill_switch=True),
+    _flag("KTPU_WAL", True, _parse_bool,
+          "Write-ahead log between KTPU_DATA_DIR snapshots (store/"
+          "durable.py): append every committed mvcc write, replay from "
+          "the snapshot RV on recovery. `0` degrades durability to "
+          "snapshot-only (the pre-WAL r16 shape).", kill_switch=True),
+    _flag("KTPU_WAL_FSYNC", "batch", _parse_wal_fsync,
+          "WAL fsync policy: `always` fsyncs per commit (the etcd "
+          "posture — an acknowledged write is on disk), `batch` group-"
+          "commits on the flush tick (durability window = one flush "
+          "interval, fsync off the commit path)."),
+    _flag("KTPU_LEASE_DURATION", 15.0, _parse_float,
+          "Leader-election lease duration in seconds (client/"
+          "leaderelection.py). Renew deadline and retry period scale "
+          "with it (2/3 and 2/15 of the lease, the reference's "
+          "15/10/2 shape) — shorter lease = faster failover detection "
+          "at more lease-write traffic."),
     _flag("KTPU_CLASS_PAD", 31, _parse_int,
           "Max real pod-equivalence classes per chunk before the "
           "per-pod fallback (plane rows bucket to the next power of "

@@ -246,6 +246,204 @@ class TestWALRecovery(unittest.TestCase):
         run(body())
 
 
+class TestSnapshotCrashAtomicity(unittest.TestCase):
+    """ISSUE r22 satellite: snapshot writes are crash-atomic — written
+    to `snapshot-<rv>.json.tmp`, fsynced, then `os.replace`d — so a
+    crash mid-snapshot can never leave a half-written file that
+    recovery would load as truth."""
+
+    def test_no_tmp_after_snapshot_and_orphan_ignored(self):
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = DurabilityManager(store, d, fsync="always",
+                                    snapshot_interval_s=3600)
+            for i in range(4):
+                await store.create("pods", make_pod(f"p{i}"))
+            mgr.wal.snapshot()
+            self.assertFalse(
+                [f for f in os.listdir(d) if f.endswith(".tmp")],
+                "normal snapshot left a .tmp behind")
+            # A crash between the tmp write and os.replace leaves an
+            # orphan — even one claiming a FUTURE rv with garbage in it.
+            orphan = os.path.join(d, "snapshot-999999.json.tmp")
+            with open(orphan, "w") as f:
+                f.write('{"rv": 999999, "tables": {"pods"')
+            await store.create("pods", make_pod("after"))
+            final_rv = store.resource_version
+            del store, mgr  # crash
+
+            re_store = recover_store(d)
+            self.assertEqual(re_store.resource_version, final_rv)
+            self.assertEqual(
+                len((await re_store.list("pods")).items), 5)
+            # the next snapshot's GC reclaims the orphan
+            mgr2 = DurabilityManager(re_store, d, fsync="always",
+                                     snapshot_interval_s=3600)
+            mgr2.wal.snapshot()
+            self.assertFalse(os.path.exists(orphan),
+                             "snapshot GC left the .tmp orphan")
+            await mgr2.stop()
+            re_store.stop()
+        run(body())
+
+    def test_crash_between_rotate_and_snapshot_write(self):
+        """Phase A (capture + segment rotation) landed, phase B (the
+        disk write) never did: recovery must fall back to the OLD
+        snapshot and replay BOTH WAL segments — no committed write
+        lost."""
+        async def body():
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = DurabilityManager(store, d, fsync="always",
+                                    snapshot_interval_s=3600)
+            for i in range(3):
+                await store.create("pods", make_pod(f"p{i}"))
+            mgr.wal.snapshot()
+            await store.create("pods", make_pod("in-old-segment"))
+            # crash window: rotate happens, write_snapshot never runs
+            mgr.wal.begin_snapshot()
+            await store.create("pods", make_pod("in-new-segment"))
+            final_rv = store.resource_version
+            del store, mgr  # crash
+
+            re_store = recover_store(d)
+            names = sorted(p["metadata"]["name"]
+                           for p in (await re_store.list("pods")).items)
+            self.assertEqual(names, sorted(
+                ["p0", "p1", "p2", "in-old-segment", "in-new-segment"]))
+            self.assertEqual(re_store.resource_version, final_rv)
+            re_store.stop()
+        run(body())
+
+    def test_stop_serializes_with_inflight_background_snapshot(self):
+        """stop() awaits the background write_snapshot worker thread
+        before taking its own final snapshot — two writers interleaving
+        segment rotation + GC was the corruption window."""
+        async def body():
+            import time as _time
+            d = tempfile.mkdtemp()
+            store = new_cluster_store()
+            install_core_validation(store)
+            mgr = DurabilityManager(store, d, fsync="batch",
+                                    flush_interval_s=0.01,
+                                    snapshot_interval_s=0.05)
+            orig = mgr.wal.write_snapshot
+
+            def slow_write(data, rv):
+                _time.sleep(0.3)   # widen the in-flight window
+                orig(data, rv)
+            mgr.wal.write_snapshot = slow_write
+            mgr.start()
+            for i in range(10):
+                await store.create("pods", make_pod(f"p{i}"))
+            for _ in range(400):   # wait for a background snapshot
+                if mgr._snap_inflight is not None:
+                    break
+                await asyncio.sleep(0.01)
+            self.assertIsNotNone(mgr._snap_inflight)
+            await mgr.stop(final_snapshot=True)  # races the worker
+
+            self.assertFalse(
+                [f for f in os.listdir(d) if f.endswith(".tmp")])
+            final_rv = store.resource_version
+            del store, mgr
+            re_store = recover_store(d)
+            self.assertEqual(re_store.resource_version, final_rv)
+            self.assertEqual(
+                len((await re_store.list("pods")).items), 10)
+            re_store.stop()
+        run(body())
+
+    def test_wal_kill_switch_snapshot_only(self):
+        """KTPU_WAL=0 degrades to snapshot-only durability (the r16
+        shape): writes after the last snapshot are legitimately lost on
+        crash, and the log file stays empty. KTPU_WAL_FSYNC routes the
+        fsync policy when no explicit argument is given."""
+        async def body():
+            from kubernetes_tpu.utils import flags
+            d = tempfile.mkdtemp()
+            with flags.scoped_set("KTPU_WAL", False), \
+                    flags.scoped_set("KTPU_WAL_FSYNC", "always"):
+                store = new_cluster_store()
+                mgr = DurabilityManager(store, d,
+                                        snapshot_interval_s=3600)
+                self.assertEqual(mgr.wal.fsync, "always")
+                self.assertFalse(mgr.wal.enabled)
+                await store.create("pods", make_pod("durable"))
+                mgr.wal.snapshot()
+                await store.create("pods", make_pod("volatile"))
+                del store, mgr  # crash: post-snapshot write unlogged
+            wals = [f for f in os.listdir(d) if f.startswith("wal-")]
+            self.assertTrue(all(
+                os.path.getsize(os.path.join(d, f)) == 0 for f in wals))
+            re_store = recover_store(d)
+            names = [p["metadata"]["name"]
+                     for p in (await re_store.list("pods")).items]
+            self.assertEqual(names, ["durable"])
+            re_store.stop()
+        run(body())
+
+
+class TestWALReplayDifferential(unittest.TestCase):
+    """ISSUE r22 satellite: randomized differential — a seeded random
+    create/update/delete stream with snapshots interleaved, crash,
+    recover, then compare the FULL recovered dump (every table, every
+    object, the rv counter) against the live store's final dump."""
+
+    def test_randomized_stream_parity(self):
+        async def body():
+            import random
+            for seed in (7, 23, 101):
+                rng = random.Random(seed)
+                d = tempfile.mkdtemp()
+                store = new_cluster_store()
+                install_core_validation(store)
+                mgr = DurabilityManager(store, d, fsync="always",
+                                        snapshot_interval_s=3600)
+                alive = {"pods": [], "nodes": []}
+                serial = 0
+                for _ in range(120):
+                    resource = rng.choice(("pods", "nodes"))
+                    roll = rng.random()
+                    if roll < 0.5 or not alive[resource]:
+                        serial += 1
+                        name = f"s{seed}-{resource[:-1]}-{serial}"
+                        obj = (make_pod(name) if resource == "pods"
+                               else make_node(name))
+                        await store.create(resource, obj)
+                        ns = obj["metadata"].get("namespace", "")
+                        alive[resource].append(
+                            f"{ns}/{name}" if ns else name)
+                    elif roll < 0.8:
+                        key = rng.choice(alive[resource])
+                        stamp = str(rng.randrange(10_000))
+
+                        def label(obj, stamp=stamp):
+                            obj["metadata"].setdefault(
+                                "labels", {})["stamp"] = stamp
+                            return obj
+                        await store.guaranteed_update(
+                            resource, key, label)
+                    else:
+                        key = rng.choice(alive[resource])
+                        alive[resource].remove(key)
+                        await store.delete(resource, key)
+                    if rng.random() < 0.05:
+                        mgr.wal.snapshot()  # checkpoint mid-stream
+                live = json.loads(store.dump())
+                del store, mgr  # crash
+
+                re_store = recover_store(d)
+                recovered = json.loads(re_store.dump())
+                self.assertEqual(recovered, live,
+                                 f"replay diverged for seed {seed}")
+                re_store.stop()
+        run(body())
+
+
 class TestServerDurabilityBootstrap(unittest.TestCase):
     """The KTPU_DATA_DIR / data_dir bootstrap (ISSUE 12 satellite):
     persistence reachable END TO END through the server, not just from

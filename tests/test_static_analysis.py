@@ -191,6 +191,39 @@ class TestLockDiscipline:
                     await asyncio.wait_for(self._cond.wait_for(
                         lambda: self._items), 1.0)  # sanctioned, wrapped
                     return list(self._items)
+
+
+        class ProcSpawner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._proc = None
+                self._workers = []
+
+            def spawn(self):
+                import subprocess
+                with self._lock:
+                    subprocess.run(["true"])      # LK207: exec under lock
+
+            def boot(self):
+                import multiprocessing
+                with self._lock:
+                    self._proc = multiprocessing.Process(  # LK207
+                        target=print)
+                    self._proc.start()            # LK207: proc receiver
+
+            def reap(self):
+                with self._lock:
+                    self._workers[0].join()       # LK207: subscripted
+
+            def tag(self, parts):
+                with self._lock:
+                    return ",".join(parts)        # clean: not a process
+
+            def reap_outside(self, proc):
+                with self._lock:
+                    alive = bool(self._proc)
+                proc.join()                       # clean: lock released
+                return alive
     """
 
     def _run(self, tmp_path):
@@ -229,6 +262,21 @@ class TestLockDiscipline:
     def test_condition_wait_is_sanctioned(self, tmp_path):
         found = self._run(tmp_path)
         assert not any("CondOk" in f.symbol for f in found)
+
+    def test_process_spawn_join_under_lock(self, tmp_path):
+        """LK207 (ISSUE r22, the multiproc supervisor): spawning an OS
+        process or joining one while holding a lock is flagged —
+        interpreter boot is ~100s of ms, a join unbounded — while
+        `",".join(...)` under a lock and a process join after release
+        stay clean."""
+        found = self._run(tmp_path)
+        lk207 = [f for f in found if f.code == "LK207"]
+        assert {f.symbol.split(":")[0] for f in lk207} == {
+            "ProcSpawner.spawn", "ProcSpawner.boot", "ProcSpawner.reap"}
+        assert len(lk207) == 4          # boot: Process(...) AND .start()
+        assert not any(f.symbol.startswith(("ProcSpawner.tag",
+                                            "ProcSpawner.reap_outside"))
+                       for f in found)
 
 
 # ---------------------------------------------------------------------------
@@ -276,7 +324,8 @@ class TestFlagRegistry:
         KTPU_SHARD_THRESHOLD, KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH,
         KTPU_SHORTLIST_K, KTPU_ADMISSION_WINDOW,
         KTPU_TRACE_THRESHOLD_MS, KTPU_DATA_DIR, KTPU_LOCK_CHECK,
-        KTPU_DEBUG_FREEZE, KTPU_TEST_PLATFORM."""
+        KTPU_DEBUG_FREEZE, KTPU_TEST_PLATFORM, KTPU_PROCESSES,
+        KTPU_WAL, KTPU_WAL_FSYNC, KTPU_LEASE_DURATION."""
         from kubernetes_tpu.utils import flags
         expected_defaults = {
             "KTPU_SERVING": True,
@@ -293,6 +342,10 @@ class TestFlagRegistry:
             "KTPU_POLICY_INDEX": True,
             "KTPU_SHARDS": None,
             "KTPU_SHARD_THRESHOLD": 100_000,
+            "KTPU_PROCESSES": None,
+            "KTPU_WAL": True,
+            "KTPU_WAL_FSYNC": "batch",
+            "KTPU_LEASE_DURATION": 15.0,
             "KTPU_CLASS_PAD": 31,
             "KTPU_PIPELINE_DEPTH": None,
             "KTPU_SHORTLIST_K": None,
@@ -311,7 +364,8 @@ class TestFlagRegistry:
         assert kills == {"KTPU_SERVING", "KTPU_CLASS_PLANES",
                          "KTPU_WAVEFRONT", "KTPU_PALLAS",
                          "KTPU_SOLVE_MODE", "KTPU_WATCH_CACHE",
-                         "KTPU_POLICY_INDEX", "KTPU_SHARDS"}
+                         "KTPU_POLICY_INDEX", "KTPU_SHARDS",
+                         "KTPU_PROCESSES", "KTPU_WAL"}
 
     def test_parse_behaviors(self, monkeypatch):
         from kubernetes_tpu.utils import flags
