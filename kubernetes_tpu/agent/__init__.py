@@ -1,7 +1,11 @@
 """Hollow-kubelet node agent (SURVEY §2.5): per-node sync loop, pod
-workers, device Allocate with a local checkpoint, heartbeats."""
+workers, device Allocate with a local checkpoint, heartbeats, merged
+config sources (config), and the read-only kubelet server (server)."""
 
 from kubernetes_tpu.agent.agent import NodeAgent
+from kubernetes_tpu.agent.config import ResolvedConfig, merge_config
 from kubernetes_tpu.agent.ledger import DeviceLedger
+from kubernetes_tpu.agent.server import AgentServer
 
-__all__ = ["NodeAgent", "DeviceLedger"]
+__all__ = ["AgentServer", "DeviceLedger", "merge_config", "NodeAgent",
+           "ResolvedConfig"]

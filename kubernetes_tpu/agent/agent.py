@@ -37,7 +37,13 @@ from kubernetes_tpu.api.types import (
     make_resource_slice,
     template_devices,
 )
+from kubernetes_tpu.agent.config import (
+    ResolvedConfig,
+    merge_config,
+    resolve_config,
+)
 from kubernetes_tpu.agent.ledger import DeviceLedger
+from kubernetes_tpu.topology.mesh import MESH_COORD_LABEL
 from kubernetes_tpu.store.mvcc import (
     AlreadyExists,
     Conflict,
@@ -60,16 +66,30 @@ class NodeAgent:
                  checkpoint_dir: str = ".",
                  node_template: dict | None = None,
                  register: bool = True,
-                 lease_period: float = 2.0,
-                 device_driver: str = "dra.ktpu",
-                 device_zones: int = 2):
+                 lease_period: float | None = None,
+                 device_driver: str | None = None,
+                 device_zones: int | None = None,
+                 topology_coord: str | None = None,
+                 config_file: str | None = None):
         self.store = store
         self.node_name = node_name
         self.node_template = node_template or {}
         self.register = register
-        self.lease_period = lease_period
-        self.device_driver = device_driver
-        self.device_zones = max(1, device_zones)
+        # Config resolution (agent/config.py): explicit constructor
+        # kwargs are the highest-precedence layer; the file + apiserver
+        # layers join at start() (the store isn't reachable yet here).
+        # Until then, defaults + overrides govern — same values the old
+        # keyword defaults carried.
+        self._config_file = config_file
+        self._config_overrides = {k: v for k, v in {
+            "leasePeriodSeconds": lease_period,
+            "deviceDriver": device_driver,
+            "deviceZones": device_zones,
+            "topologyCoord": topology_coord,
+        }.items() if v is not None}
+        self.kubelet_config: ResolvedConfig = merge_config(
+            ("override", self._config_overrides))
+        self._apply_config(self.kubelet_config)
         self.ledger = DeviceLedger(
             os.path.join(checkpoint_dir,
                          f"devices-{node_name}.checkpoint.json"),
@@ -81,6 +101,9 @@ class NodeAgent:
         #: workers drain this map serially per key, latest state wins
         #: (pod_workers.go UpdatePod semantics).
         self._latest: dict[str, dict | None] = {}
+        #: pod key -> last observed object — the agent's LOCAL pod view
+        #: the kubelet server's /pods endpoint serves.
+        self._pods: dict[str, dict] = {}
         self._active: set[str] = set()
         #: pod keys with a staged-completion timer armed (restart-safe:
         #: _sync_pod re-arms for Running pods found after a relist).
@@ -121,8 +144,25 @@ class NodeAgent:
             await asyncio.gather(
                 *(a._start_sync() for a in agents[lo:lo + window]))
 
+    def _apply_config(self, cfg: ResolvedConfig) -> None:
+        self.lease_period = float(cfg["leasePeriodSeconds"])
+        self.device_driver = cfg["deviceDriver"]
+        self.device_zones = max(1, int(cfg["deviceZones"]))
+        self.topology_coord = cfg["topologyCoord"]
+
+    def resident_pods(self) -> list[dict]:
+        """This agent's local view of its bound pods (the /pods
+        endpoint's payload), stable key order."""
+        return [self._pods[k] for k in sorted(self._pods)]
+
     async def _start_register(self) -> None:
-        """Phase 1: local checkpoint restore + Node registration."""
+        """Phase 1: config resolve + local checkpoint restore + Node
+        registration (the config layers must land first: the lease
+        period and the topology coordinate both feed registration)."""
+        self.kubelet_config = await resolve_config(
+            self.store, self.node_name, self._config_file,
+            self._config_overrides)
+        self._apply_config(self.kubelet_config)
         self.ledger.load()
         if self.register:
             await self._register_node()
@@ -187,16 +227,41 @@ class NodeAgent:
         self._workers.clear()
         if not graceful:
             self._latest.clear()
+            self._pods.clear()
             self._armed.clear()
             self._active.clear()
 
     async def _register_node(self) -> None:
         node = make_node(self.node_name, **self.node_template)
         node["metadata"].setdefault("annotations", {})[AGENT_ANN] = "true"
+        if self.topology_coord:
+            # Interconnect position (topology/mesh node_cell contract):
+            # an explicit coordinate label beats the scheduler's
+            # name-derived fallback.
+            node["metadata"].setdefault("labels", {})[
+                MESH_COORD_LABEL] = str(self.topology_coord)
         try:
             await self.store.create("nodes", node)
         except AlreadyExists:
-            pass  # restart: the Node object survives us
+            # Restart (or a pre-staged Node): the object survives us,
+            # but the coordinate label must still land — the scheduler
+            # reads it off the Node, not the agent.
+            if self.topology_coord:
+                coord = str(self.topology_coord)
+
+                def stamp(existing):
+                    labels = existing["metadata"].setdefault("labels", {})
+                    if labels.get(MESH_COORD_LABEL) == coord:
+                        return None
+                    labels[MESH_COORD_LABEL] = coord
+                    return existing
+                try:
+                    await self.store.guaranteed_update(
+                        "nodes", self.node_name, stamp, return_copy=False)
+                except StoreError:
+                    logger.exception(
+                        "agent %s: coord label stamp failed",
+                        self.node_name)
         await self._publish_devices()
 
     async def _publish_devices(self) -> None:
@@ -318,6 +383,10 @@ class NodeAgent:
 
     def _observe(self, key: str, obj: dict | None) -> None:
         self._latest[key] = obj
+        if obj is None:
+            self._pods.pop(key, None)
+        else:
+            self._pods[key] = obj
         if key in self._active or self._stopped:
             return
         self._active.add(key)

@@ -58,6 +58,7 @@ ENTRY_MODULE_SUFFIXES = (
     "kubernetes_tpu/parallel/sharded.py",
     "kubernetes_tpu/parallel/mesh.py",
     "kubernetes_tpu/serving/fastpath.py",
+    "kubernetes_tpu/topology/device.py",
 )
 
 _JIT_DECORATORS = ("jax.jit", "jit", "jax.vmap", "shard_map",

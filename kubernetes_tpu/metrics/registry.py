@@ -616,6 +616,25 @@ class SchedulerMetrics:
             "scheduler_fragmentation_pct",
             "Mean stranded-capacity fraction (pct) across occupied "
             "nodes after the latest measured run")
+        #: Topology-slice observability (kubernetes_tpu/topology —
+        #: ROADMAP #5's shaped-gang direction): gangs whose Permit
+        #: contiguity check released a whole slice, the
+        #: stranded-for-shape free capacity the latest slice plan saw
+        #: (free cells NO feasible placement of the requested shape
+        #: covers — the mesh analog of scheduler_fragmentation_pct),
+        #: and coordinate-plane rebuilds (steady state: reuse, zero).
+        self.slice_gangs_bound = r.counter(
+            "scheduler_slice_gangs_bound_total",
+            "Slice-shaped gangs released by Permit as one contiguous "
+            "sub-mesh")
+        self.slice_fragmentation_pct = r.gauge(
+            "scheduler_slice_fragmentation_pct",
+            "Free mesh cells covered by NO feasible placement of the "
+            "most recently planned slice shape (pct)")
+        self.topology_plane_rebuilds = r.counter(
+            "topology_plane_rebuilds_total",
+            "Rebuilds of the tensorized interconnect coordinate planes "
+            "(mesh flags or node set moved; reuse does not count)")
         #: Sharded-control-plane observability (ROADMAP #5): per-shard
         #: host-prep rebuild counts (a shard increments only when its
         #: rows were actually rewritten — the incremental path's

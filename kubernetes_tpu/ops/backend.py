@@ -562,6 +562,8 @@ _FILTER_ACTIVE = {
         lambda plugin, pi, snap: plugin.active_for(pi),
     "DynamicResources":
         lambda plugin, pi, snap: plugin.active_for(pi),
+    "TopologySlice":
+        lambda plugin, pi, snap: plugin.active_for(pi),
 }
 _SCORE_ACTIVE = {
     "InterPodAffinity": lambda plugin, pi, snap: bool(
@@ -1119,6 +1121,9 @@ class TPUBackend:
             if self.metrics is not None:
                 for s in self._ct.shard_rebuilds:
                     self.metrics.shard_tensor_rebuilds.inc(shard=str(s))
+                topo = getattr(self._ct, "topology", None)
+                if topo is not None and topo.rebuilt:
+                    self.metrics.topology_plane_rebuilds.inc()
         if self._row_fp != self._ct._static_fp:
             self._row_cache.clear()
             self._row_fp = self._ct._static_fp

@@ -47,6 +47,8 @@ from kubernetes_tpu.api.types import (
     toleration_tolerates_taint,
 )
 from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo, Snapshot
+from kubernetes_tpu.topology.planes import TopologyPlanes, build_topology_planes
+from kubernetes_tpu.utils import flags
 
 #: Node axis is padded to a multiple of this so node add/remove churn doesn't
 #: recompile the kernels every time (and tiles map cleanly onto the VPU/MXU).
@@ -237,6 +239,13 @@ class ClusterTensors:
             self.taint_filter_mat, self.taint_prefer_mat = \
                 self.taints.node_rows(nodes, N)
         self._static_fp = fp
+        # Topology coordinate planes (topology/planes): static per
+        # node-set like the taint interning, absent entirely when the
+        # kill switch is off (flat-capacity call graph, no new arrays).
+        self.topology: TopologyPlanes | None = (
+            build_topology_planes(
+                nodes, N, getattr(prev, "topology", None))
+            if flags.get("KTPU_TOPOLOGY") else None)
         self._shard_accounting(
             prev=prev if incremental else None,
             changed=changed if incremental else None)
@@ -300,6 +309,13 @@ class ClusterTensors:
                 self.used_nz_q[i, j] = _quant_ceil(
                     ni.nonzero_requested.get(r), sc[j])
             self.used_pods[i] = ni.requested.pods
+        # spec_seq pins node specs identical, so the planes fingerprint
+        # matches and this is a pure reuse (rebuilt=False) — unless the
+        # mesh flags moved live, which forces the honest rebuild.
+        self.topology = (
+            build_topology_planes(
+                nodes, self.n_pad, getattr(prev, "topology", None))
+            if flags.get("KTPU_TOPOLOGY") else None)
         self._shard_accounting(prev=prev, changed=changed)
         return True
 

@@ -23,7 +23,18 @@ Kinds (performance-config.yaml `faults:` entries / bench --churn-fault):
   a new revision label (a deployment rollout wave's shape mid-churn).
 - gangArrival — create `count` pods AT ONCE from `podTemplate` (e.g.
   high-priority, colliding with the r6 preemption and r9 policy paths);
-  recovery = the whole gang bound.
+  recovery = the whole gang bound. With `sliceShape: [s0, s1(, s2)]`
+  the gang is SLICE-SHAPED (topology/): a PodGroup with that shape is
+  created first, every pod carries its group label, count defaults to
+  prod(shape), and recovery means the whole gang bound as one
+  contiguous sub-mesh (Coscheduling Permit enforces the contiguity).
+- sliceDeath — kill a member node out from under a bound slice gang
+  (`group` names the gang — a prior gangArrival's `slice-<at_ms>`):
+  cordon + agent-kill the first member's node, delete the gang's pods
+  and PodGroup, then recreate the gang under `<group>-r<at_ms>` with
+  the same `sliceShape`; recovery = the replacement gang RE-COALESCED
+  on a fresh contiguous sub-mesh that avoids the dead cell — the
+  ChurnSlicePacking family's time-to-re-coalesce headline.
 - killLeader  — SIGKILL the ACTIVE scheduler process mid-wave
   (multi-process runs only: needs the injector's `control_plane`
   seam — multiproc/controlplane.py). The standby must win the lease
@@ -42,6 +53,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import logging
+import math
 import random
 import time
 from typing import Any, Callable, Mapping
@@ -108,7 +120,16 @@ def build_fault_timeline(specs: list[Mapping], seed: int = 0,
             # now so two runs roll the same slice.
             params.setdefault("offset", rng.randrange(1 << 16))
         if kind == "gangArrival":
-            params.setdefault("count", 8)
+            shape = params.get("sliceShape")
+            params.setdefault(
+                "count", math.prod(int(s) for s in shape) if shape else 8)
+        if kind == "sliceDeath":
+            # Both are identity, not chance: the timeline must say WHICH
+            # gang dies and what shape re-coalesces.
+            for req in ("group", "sliceShape"):
+                if req not in params:
+                    raise ValueError(
+                        f"fault #{i} (sliceDeath) needs {req!r}")
         if kind == "killLeader":
             # Canary pods probing scheduling liveness across failover.
             params.setdefault("count", 8)
@@ -296,15 +317,96 @@ class FaultInjector:
     async def _do_gangArrival(self, ev: FaultEvent, rec: dict) -> None:
         count = int(ev.params["count"])
         tmpl = {**self.pod_template, **(ev.params.get("podTemplate") or {})}
-        names = [f"gang-{round(ev.at * 1e3)}-{i}" for i in range(count)]
+        ns = tmpl.get("namespace", self.namespace)
+        shape = ev.params.get("sliceShape")
+        if shape:
+            # Slice-shaped gang: the PodGroup (with sliceShape) must
+            # exist BEFORE the pods so Coscheduling/TopologySlice see a
+            # resolvable group from the first attempt.
+            group = str(ev.params.get("group",
+                                      f"slice-{round(ev.at * 1e3)}"))
+            tmpl = await self._create_slice_group(group, shape, tmpl, ns)
+            names = [f"{group}-{i}" for i in range(count)]
+        else:
+            names = [f"gang-{round(ev.at * 1e3)}-{i}" for i in range(count)]
         t0 = self.clock()
         await self._create_many(names, tmpl)
         rec["replacements"] = count
         # The gang may land in the fault template's own namespace — the
         # bound-key wait must watch THAT one, not the injector default.
-        await self._await_bound(
-            names, rec, t0,
-            namespace=tmpl.get("namespace", self.namespace))
+        await self._await_bound(names, rec, t0, namespace=ns)
+
+    async def _do_sliceDeath(self, ev: FaultEvent, rec: dict) -> None:
+        from kubernetes_tpu.scheduler.plugins.coscheduling import (
+            POD_GROUP_LABEL,
+        )
+        group = str(ev.params["group"])
+        shape = [int(s) for s in ev.params["sliceShape"]]
+        tmpl = {**self.pod_template, **(ev.params.get("podTemplate") or {})}
+        ns = tmpl.get("namespace", self.namespace)
+        try:
+            pods = (await self.store.list("pods")).items
+        except StoreError:
+            pods = []
+        members = sorted(
+            (p for p in pods
+             if (p.get("metadata", {}).get("labels") or {})
+             .get(POD_GROUP_LABEL) == group
+             and p.get("metadata", {}).get("namespace", "default") == ns),
+            key=lambda p: p["metadata"]["name"])
+        if not members:
+            logger.error("sliceDeath: gang %s has no pods — skipped", group)
+            rec["recovered"] = False
+            return
+        # Kill the first member's node: cordon (the scheduler must not
+        # re-place onto the corpse — the bench has no kubelet ack, so an
+        # un-cordoned dead node would still "bind") and stop its agent.
+        victim = next((p["spec"].get("nodeName") for p in members
+                       if p["spec"].get("nodeName")), None)
+        t_kill = self.clock()
+        if victim is not None:
+            rec["node"] = victim
+            await self._set_unschedulable(victim, True)
+            agent = self.agents.get(victim)
+            if agent is not None:
+                await agent.stop(graceful=False)
+        rec["displaced_pods"] = len(members)
+        for p in members:
+            try:
+                await self.store.delete("pods", namespaced_name(p))
+                self.net_created -= 1
+            except StoreError:
+                pass
+        try:
+            await self.store.delete("podgroups", f"{ns}/{group}")
+        except StoreError:
+            pass
+        # Re-coalesce: the same shape under a fresh group name must find
+        # a contiguous sub-mesh that routes around the dead cell.
+        regroup = f"{group}-r{round(ev.at * 1e3)}"
+        tmpl = await self._create_slice_group(regroup, shape, tmpl, ns)
+        names = [f"{regroup}-{i}" for i in range(math.prod(shape))]
+        await self._create_many(names, tmpl)
+        rec["replacements"] = len(names)
+        await self._await_bound(names, rec, t_kill, namespace=ns)
+
+    async def _create_slice_group(self, group: str, shape, tmpl: Mapping,
+                                  ns: str) -> dict:
+        """Create the slice-shaped PodGroup and return the pod template
+        stamped with its membership label."""
+        from kubernetes_tpu.scheduler.plugins.coscheduling import (
+            POD_GROUP_LABEL,
+            make_pod_group,
+        )
+        count = math.prod(int(s) for s in shape)
+        try:
+            await self.store.create("podgroups", make_pod_group(
+                group, min_member=count, namespace=ns, slice_shape=shape))
+        except StoreError:
+            logger.warning("slice gang PodGroup %s create failed", group)
+        return {**tmpl,
+                "labels": {**(tmpl.get("labels") or {}),
+                           POD_GROUP_LABEL: group}}
 
     async def _do_killLeader(self, ev: FaultEvent, rec: dict) -> None:
         cp = self.control_plane

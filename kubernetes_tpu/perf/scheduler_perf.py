@@ -250,6 +250,15 @@ class WorkloadResult:
         self.churn_fragmentation_curve: list[list[float]] = []
         self.churn_descheduler_evictions = 0
         self.churn_rebalance_recovery_s: float | None = None
+        #: Topology-slice accounting (topology/): slice-shaped gangs
+        #: Permit released as one contiguous sub-mesh over the measured
+        #: phase, the slice-fragmentation gauge after the last plan
+        #: (free cells covered by NO feasible placement of that shape),
+        #: and coordinate-plane rebuilds (reuse does not count — a
+        #: stable node set should rebuild once, not per chunk).
+        self.slice_gangs_bound_total = 0
+        self.slice_fragmentation_pct = 0.0
+        self.topology_plane_rebuilds_total = 0
 
     def as_dict(self) -> dict:
         import math
@@ -369,6 +378,11 @@ class WorkloadResult:
                 list(s) for s in self.churn_fragmentation_curve],
             "churn_descheduler_evictions": self.churn_descheduler_evictions,
             "churn_rebalance_recovery_s": self.churn_rebalance_recovery_s,
+            "slice_gangs_bound_total": self.slice_gangs_bound_total,
+            "slice_fragmentation_pct": round(
+                self.slice_fragmentation_pct, 2),
+            "topology_plane_rebuilds_total":
+                self.topology_plane_rebuilds_total,
         }
 
 
@@ -1424,6 +1438,8 @@ class PerfRunner:
             metrics.resident_plane_refresh.sum(),
             metrics.solver_optimal_solves.value(),
             metrics.solver_optimal_fallbacks.value(),
+            metrics.slice_gangs_bound.value(),
+            metrics.topology_plane_rebuilds.value(),
             metrics.attempt_window().mark())
 
     def _end_measure(self, result: WorkloadResult,
@@ -1440,6 +1456,7 @@ class PerfRunner:
          shard_rb_base, shard_s_base, xshard_base,
          fast_base, coalesced_base, refresh_base, refresh_s_base,
          opt_base, opt_fb_base,
+         slice_gangs_base, topo_rb_base,
          window_mark) = window
         dt = time.monotonic() - t0
         result.measured_pods = count
@@ -1537,6 +1554,12 @@ class PerfRunner:
             metrics.solver_optimal_solves.value() - opt_base)
         result.solver_optimal_fallbacks_total = int(
             metrics.solver_optimal_fallbacks.value() - opt_fb_base)
+        result.slice_gangs_bound_total = int(
+            metrics.slice_gangs_bound.value() - slice_gangs_base)
+        result.topology_plane_rebuilds_total = int(
+            metrics.topology_plane_rebuilds.value() - topo_rb_base)
+        result.slice_fragmentation_pct = \
+            metrics.slice_fragmentation_pct.value()
         # Gauge is base-unit seconds now (metrics lint); the detail JSON
         # field keeps its ms name for report continuity.
         result.admission_window_ms = 1e3 * metrics.admission_window.value()

@@ -43,11 +43,17 @@ def _pod_group_index(obj: dict) -> list[str]:
 
 
 def make_pod_group(name: str, min_member: int, namespace: str = "default",
-                   schedule_timeout_seconds: float | None = None) -> dict:
+                   schedule_timeout_seconds: float | None = None,
+                   slice_shape: list | tuple | None = None) -> dict:
     from kubernetes_tpu.api.meta import new_object
     spec = {"minMember": min_member}
     if schedule_timeout_seconds is not None:
         spec["scheduleTimeoutSeconds"] = schedule_timeout_seconds
+    if slice_shape is not None:
+        # Slice-shaped gang (topology/): members must land on one
+        # contiguous sub-mesh of this shape (TopologySlice plans it,
+        # Permit here enforces it before release).
+        spec["sliceShape"] = [int(s) for s in slice_shape]
     return new_object("PodGroup", name, namespace, spec=spec)
 
 
@@ -62,9 +68,13 @@ class Coscheduling(Plugin):
         self._waiting: dict[str, set[str]] = defaultdict(set)
         #: group key -> pod keys bound (left the barrier)
         self._bound: dict[str, set[str]] = defaultdict(set)
+        #: group key -> {pod key -> reserved node} — the membership the
+        #: sliceShape contiguity check at Permit verifies.
+        self._nodes: dict[str, dict[str, str]] = defaultdict(dict)
         self.scheduler = None      # wired by Scheduler (allow/reject handles)
         self.pg_informer = None    # wired via set_informers
         self.pod_informer = None
+        self.node_informer = None  # node labels for the coordinate map
 
     def set_scheduler(self, scheduler) -> None:
         self.scheduler = scheduler
@@ -76,6 +86,7 @@ class Coscheduling(Plugin):
 
         self.pg_informer = factory.informer("podgroups")
         self.pod_informer = factory.informer("pods")
+        self.node_informer = factory.informer("nodes")
         # O(1) sibling counts for pre_enqueue (vs scanning every pod).
         self.pod_informer.indexer.add_indexer("podgroup", _pod_group_index)
 
@@ -90,6 +101,7 @@ class Coscheduling(Plugin):
             key = (f"{ns}/{obj['metadata']['name']}")
             self._bound[f"{ns}/{name}"].discard(key)
             self._waiting[f"{ns}/{name}"].discard(key)
+            self._nodes[f"{ns}/{name}"].pop(key, None)
 
         self.pod_informer.add_event_handler(ResourceEventHandler(
             on_delete=on_pod_delete))
@@ -138,6 +150,52 @@ class Coscheduling(Plugin):
                 f"gang {gk}: fewer than minMember={min_member} pods exist")
         return Status.success()
 
+    def _slice_misaligned(self, gk: str, pg: dict) -> str | None:
+        """Reason the assembled gang's reserved nodes do NOT form one
+        contiguous sub-mesh of the group's sliceShape; None = aligned
+        (or not a slice-shaped gang / topology off — count-only gangs
+        keep the pre-topology barrier exactly)."""
+        from kubernetes_tpu.topology.mesh import (
+            node_cell, normalize_shape, parse_mesh_shape)
+        from kubernetes_tpu.topology.slices import is_contiguous_slice
+        from kubernetes_tpu.utils import flags
+
+        raw = pg["spec"].get("sliceShape")
+        if not raw or not flags.get("KTPU_TOPOLOGY"):
+            return None
+        try:
+            shape = normalize_shape(raw)
+        except (ValueError, TypeError):
+            return None  # malformed shape: count-only semantics
+        if self.node_informer is None:
+            return "no node informer for the slice contiguity check"
+        members = self._nodes.get(gk, {})
+        node_names = set(members.values())
+        if len(node_names) < len(members):
+            return "two slice members reserved the same node"
+        all_nodes = self.node_informer.indexer.list()
+        spec = parse_mesh_shape(
+            flags.get("KTPU_MESH_SHAPE"), len(all_nodes))
+        cells = []
+        for name in node_names:
+            obj = self.node_informer.indexer.get(name)
+            labels = (obj or {}).get("metadata", {}).get("labels") or {}
+            cell = node_cell(name, labels, spec)
+            if cell is None:
+                return f"member node {name} is off-mesh"
+            cells.append(cell)
+        if not is_contiguous_slice(cells, spec, shape):
+            return ("reserved nodes do not form a contiguous "
+                    f"{'x'.join(str(s) for s in raw)} sub-mesh")
+        return None
+
+    def reserve(self, state: CycleState, pod: PodInfo,
+                node_name: str) -> Status:
+        gk = self.group_key(pod)
+        if gk is not None:
+            self._nodes[gk][pod.key] = node_name
+        return Status.success()
+
     def permit(self, state: CycleState, pod: PodInfo,
                node_name: str) -> tuple[Status, float]:
         gk = self.group_key(pod)
@@ -149,6 +207,19 @@ class Coscheduling(Plugin):
         min_member = int(pg["spec"].get("minMember", 1))
         assembled = (len(self._waiting[gk]) + len(self._bound[gk]) + 1)
         if assembled >= min_member:
+            misaligned = self._slice_misaligned(gk, pg)
+            if misaligned is not None:
+                # A complete but BENT gang must not bind: reject the
+                # whole membership (all-or-nothing) so the next attempt
+                # replans from a fresh TopologySlice placement.
+                waiting = self._waiting.pop(gk, set())
+                if self.scheduler is not None:
+                    for key in waiting:
+                        self.scheduler.reject_waiting_pod(key)
+                logger.info("gang %s: %s; rejecting %d waiters",
+                            gk, misaligned, len(waiting))
+                return Status.unschedulable(
+                    f"gang {gk}: {misaligned}"), 0.0
             # Gang complete: release every parked sibling.
             waiting = self._waiting.pop(gk, set())
             if self.scheduler is not None:
@@ -156,6 +227,9 @@ class Coscheduling(Plugin):
                     self.scheduler.allow_waiting_pod(key)
             self._bound[gk].update(waiting)
             self._bound[gk].add(pod.key)
+            if pg["spec"].get("sliceShape") and self.scheduler is not None \
+                    and getattr(self.scheduler, "metrics", None) is not None:
+                self.scheduler.metrics.slice_gangs_bound.inc()
             return Status.success(), 0.0
         self._waiting[gk].add(pod.key)
         timeout = float(pg["spec"].get("scheduleTimeoutSeconds",
@@ -170,6 +244,7 @@ class Coscheduling(Plugin):
             return
         self._waiting[gk].discard(pod.key)
         self._bound[gk].discard(pod.key)
+        self._nodes[gk].pop(pod.key, None)
         waiting = self._waiting.pop(gk, set())
         if waiting and self.scheduler is not None:
             logger.info("gang %s: member %s failed; rejecting %d waiters",

@@ -31,6 +31,7 @@ from kubernetes_tpu.scheduler.plugins.noderesourcetopology import (
     NodeResourceTopologyMatch,
 )
 from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.scheduler.plugins.topologyslice import TopologySlice
 from kubernetes_tpu.scheduler.plugins.volumebinding import (
     NodeVolumeLimits,
     VolumeBinding,
@@ -42,6 +43,7 @@ from kubernetes_tpu.scheduler.plugins.volumebinding import (
 #: registered but not default-enabled (out-of-tree in the reference).
 IN_TREE: dict[str, Callable] = {
     "Coscheduling": Coscheduling,
+    "TopologySlice": TopologySlice,
     "DynamicResources": DynamicResources,
     "NodeResourceTopologyMatch": NodeResourceTopologyMatch,
     "PrioritySort": PrioritySort,

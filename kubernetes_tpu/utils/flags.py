@@ -162,6 +162,21 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
     _flag("KTPU_DESCHEDULER_BUDGET", 8, _parse_int,
           "Disruption budget: max evict-and-replace moves the "
           "descheduler may issue per sync cycle."),
+    _flag("KTPU_TOPOLOGY", True, _parse_bool,
+          "Topology-aware TPU-slice placement (kubernetes_tpu/topology): "
+          "interconnect coordinate planes on the cluster tensors, the "
+          "device-side contiguous sub-mesh Filter/Score behind the "
+          "TopologySlice plugin, and Coscheduling's sliceShape contiguity "
+          "check at Permit. `0` degrades structurally to flat capacity "
+          "vectors — count-only gangs, no coordinate planes, assignments "
+          "bit-identical on topology-free workloads.", kill_switch=True),
+    _flag("KTPU_MESH_SHAPE", "auto", _parse_str,
+          "Interconnect mesh dimensions, e.g. `4x8` (2D torus), `2x4x4` "
+          "(3D torus) or `4x8:mesh` (no wraparound). `auto` derives a "
+          "near-square 2D torus from the node count. Nodes map to "
+          "coordinates via the `ktpu.io/topology-coord` label agents "
+          "stamp at registration, falling back to the trailing integer "
+          "in the node name (row-major)."),
     _flag("KTPU_WATCH_CACHE", True, _parse_bool,
           "Watch-cache serving tier (store/cacher.py). `0` degrades "
           "every LIST/watch to the direct-mvcc path.", kill_switch=True),

@@ -1,0 +1,314 @@
+"""Kubelet seams (kubernetes_tpu/agent): merged config sources and the
+read-only server.
+
+Pins: (a) config precedence is defaults < file < apiserver <
+constructor override, FIELD-BY-FIELD (a layer overrides only the keys
+it sets), with per-field source attribution; (b) unknown keys and
+malformed values degrade to the lower layer with a warning, never a
+crash; (c) the apiserver layer is the node-named `kubeletconfigs`
+object falling back to the cluster-wide `default`; (d) the read-only
+server answers /healthz, /pods (the agent's LOCAL resident view) and
+/configz (resolved values + attribution) with no mutating route.
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import unittest
+
+from kubernetes_tpu.agent import NodeAgent, merge_config
+from kubernetes_tpu.agent.config import (
+    DEFAULTS,
+    fetch_apiserver_source,
+    load_file_source,
+    resolve_config,
+)
+from kubernetes_tpu.agent.server import AgentServer
+from kubernetes_tpu.api.meta import new_object
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.store import install_core_validation, new_cluster_store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(pred, timeout=8.0, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        got = await pred()
+        if got:
+            return got
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestConfigMerge(unittest.TestCase):
+    def test_defaults_only(self):
+        cfg = merge_config()
+        self.assertEqual(cfg.values, DEFAULTS)
+        self.assertTrue(all(s == "default" for s in cfg.sources.values()))
+
+    def test_precedence_field_by_field(self):
+        # file sets lease, apiserver sets zones: each field keeps the
+        # HIGHEST layer that actually set it — apiserver does not reset
+        # the file's lease, the file does not shadow apiserver zones.
+        cfg = merge_config(
+            ("file", {"leasePeriodSeconds": 7.5, "deviceZones": 4}),
+            ("apiserver", {"deviceZones": 8}),
+        )
+        self.assertEqual(cfg["leasePeriodSeconds"], 7.5)
+        self.assertEqual(cfg["deviceZones"], 8)
+        self.assertEqual(cfg["deviceDriver"], DEFAULTS["deviceDriver"])
+        self.assertEqual(cfg.sources["leasePeriodSeconds"], "file")
+        self.assertEqual(cfg.sources["deviceZones"], "apiserver")
+        self.assertEqual(cfg.sources["deviceDriver"], "default")
+
+    def test_override_layer_wins(self):
+        cfg = merge_config(
+            ("file", {"leasePeriodSeconds": 7.5}),
+            ("apiserver", {"leasePeriodSeconds": 9.0}),
+            ("override", {"leasePeriodSeconds": 0.25}),
+        )
+        self.assertEqual(cfg["leasePeriodSeconds"], 0.25)
+        self.assertEqual(cfg.sources["leasePeriodSeconds"], "override")
+
+    def test_unknown_and_malformed_degrade(self):
+        with self.assertLogs("kubernetes_tpu.agent.config",
+                             level="WARNING"):
+            cfg = merge_config(
+                ("file", {"notAField": 1, "leasePeriodSeconds": "nope"}))
+        # Unknown key ignored, bad value falls back to the default.
+        self.assertEqual(cfg["leasePeriodSeconds"],
+                         DEFAULTS["leasePeriodSeconds"])
+        self.assertNotIn("notAField", cfg.values)
+
+    def test_coercion(self):
+        # Hand-edited files carry strings; fields coerce per-type.
+        cfg = merge_config(("file", {"leasePeriodSeconds": "5",
+                                     "deviceZones": "4"}))
+        self.assertEqual(cfg["leasePeriodSeconds"], 5.0)
+        self.assertEqual(cfg["deviceZones"], 4)
+
+    def test_configz_payload(self):
+        cfg = merge_config(("file", {"deviceDriver": "dra.other"}))
+        z = cfg.as_configz()
+        self.assertEqual(z["kubeletconfig"]["deviceDriver"], "dra.other")
+        self.assertEqual(z["sources"]["deviceDriver"], "file")
+
+    def test_file_source_missing_and_malformed(self):
+        self.assertEqual(load_file_source(None), {})
+        self.assertEqual(load_file_source("/does/not/exist.json"), {})
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as f:
+            f.write("{not json")
+            path = f.name
+        try:
+            with self.assertLogs("kubernetes_tpu.agent.config",
+                                 level="WARNING"):
+                self.assertEqual(load_file_source(path), {})
+        finally:
+            os.unlink(path)
+
+    def test_apiserver_source_node_beats_default(self):
+        async def body():
+            store = new_cluster_store()
+            try:
+                await store.create("kubeletconfigs", new_object(
+                    "KubeletConfiguration", "default", "default",
+                    spec={"deviceZones": 2}))
+                await store.create("kubeletconfigs", new_object(
+                    "KubeletConfiguration", "nodeA", "default",
+                    spec={"deviceZones": 6}))
+                self.assertEqual(
+                    await fetch_apiserver_source(store, "nodeA"),
+                    {"deviceZones": 6})
+                # No node-named object → the cluster-wide default.
+                self.assertEqual(
+                    await fetch_apiserver_source(store, "nodeB"),
+                    {"deviceZones": 2})
+                # Neither existing is normal: empty layer.
+                await store.delete("kubeletconfigs", "default/default")
+                await store.delete("kubeletconfigs", "default/nodeA")
+                self.assertEqual(
+                    await fetch_apiserver_source(store, "nodeB"), {})
+            finally:
+                store.stop()
+        run(body())
+
+    def test_resolve_full_stack(self):
+        async def body():
+            store = new_cluster_store()
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".json", delete=False) as f:
+                json.dump({"leasePeriodSeconds": 6.0,
+                           "deviceDriver": "dra.file"}, f)
+                path = f.name
+            try:
+                await store.create("kubeletconfigs", new_object(
+                    "KubeletConfiguration", "n0", "default",
+                    spec={"deviceDriver": "dra.api"}))
+                cfg = await resolve_config(
+                    store, "n0", config_file=path,
+                    overrides={"deviceZones": 3})
+                self.assertEqual(cfg["leasePeriodSeconds"], 6.0)   # file
+                self.assertEqual(cfg["deviceDriver"], "dra.api")   # api
+                self.assertEqual(cfg["deviceZones"], 3)            # kwarg
+                self.assertEqual(cfg.sources["leasePeriodSeconds"], "file")
+                self.assertEqual(cfg.sources["deviceDriver"], "apiserver")
+                self.assertEqual(cfg.sources["deviceZones"], "override")
+            finally:
+                os.unlink(path)
+                store.stop()
+        run(body())
+
+
+class TestAgentAppliesConfig(unittest.TestCase):
+    def test_apiserver_layer_reaches_running_agent(self):
+        """An agent started with NO kwargs resolves its lease period
+        from the apiserver's node-named config object."""
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            tmp = tempfile.mkdtemp(prefix="ktpu-seams-")
+            try:
+                await store.create("kubeletconfigs", new_object(
+                    "KubeletConfiguration", "n0", "default",
+                    spec={"leasePeriodSeconds": 0.123}))
+                agent = NodeAgent(store, "n0", checkpoint_dir=tmp)
+                await agent.start()
+                try:
+                    self.assertEqual(agent.lease_period, 0.123)
+                    self.assertEqual(
+                        agent.kubelet_config.sources["leasePeriodSeconds"],
+                        "apiserver")
+                finally:
+                    await agent.stop()
+            finally:
+                store.stop()
+        run(body())
+
+    def test_coord_label_stamped_on_preexisting_node(self):
+        """Restart / pre-staged Node: create raced AlreadyExists, but
+        the coordinate label must still land on the surviving object."""
+        async def body():
+            from kubernetes_tpu.api.types import make_node
+            from kubernetes_tpu.topology import MESH_COORD_LABEL
+            store = new_cluster_store()
+            install_core_validation(store)
+            tmp = tempfile.mkdtemp(prefix="ktpu-seams-")
+            try:
+                await store.create("nodes", make_node("n0"))
+                agent = NodeAgent(store, "n0", checkpoint_dir=tmp,
+                                  topology_coord="3,1")
+                await agent.start()
+                try:
+                    node = await store.get("nodes", "n0")
+                    self.assertEqual(
+                        node["metadata"]["labels"][MESH_COORD_LABEL],
+                        "3,1")
+                finally:
+                    await agent.stop()
+            finally:
+                store.stop()
+        run(body())
+
+    def test_constructor_kwarg_beats_apiserver(self):
+        async def body():
+            store = new_cluster_store()
+            install_core_validation(store)
+            tmp = tempfile.mkdtemp(prefix="ktpu-seams-")
+            try:
+                await store.create("kubeletconfigs", new_object(
+                    "KubeletConfiguration", "n0", "default",
+                    spec={"leasePeriodSeconds": 0.123}))
+                agent = NodeAgent(store, "n0", checkpoint_dir=tmp,
+                                  lease_period=9.0)
+                await agent.start()
+                try:
+                    self.assertEqual(agent.lease_period, 9.0)
+                finally:
+                    await agent.stop()
+            finally:
+                store.stop()
+        run(body())
+
+
+class TestAgentServer(unittest.TestCase):
+    """Read-endpoint smoke: /healthz, /pods, /configz over real HTTP."""
+
+    def test_read_endpoints(self):
+        async def body():
+            import aiohttp
+            store = new_cluster_store()
+            install_core_validation(store)
+            tmp = tempfile.mkdtemp(prefix="ktpu-seams-")
+            agent = NodeAgent(store, "n0", checkpoint_dir=tmp,
+                              topology_coord="1,2")
+            await agent.start()
+            server = AgentServer(agent)
+            await server.start()
+            base = f"http://127.0.0.1:{server.port}"
+            try:
+                # Bind a pod onto the node; the agent's local view
+                # (via its field-filtered watch) backs /pods.
+                await store.create("pods", make_pod(
+                    "resident", uid="resident"))
+                await store.subresource(
+                    "pods", "default/resident", "binding",
+                    {"target": {"name": "n0"}})
+                await wait_for(
+                    lambda: asyncio.sleep(0, bool(agent.resident_pods())),
+                    msg="agent observed its pod")
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(base + "/healthz") as r:
+                        self.assertEqual(r.status, 200)
+                        self.assertEqual(await r.text(), "ok")
+                    async with http.get(base + "/pods") as r:
+                        self.assertEqual(r.status, 200)
+                        pods = await r.json()
+                        self.assertEqual(pods["kind"], "PodList")
+                        names = [p["metadata"]["name"]
+                                 for p in pods["items"]]
+                        self.assertEqual(names, ["resident"])
+                    async with http.get(base + "/configz") as r:
+                        self.assertEqual(r.status, 200)
+                        z = await r.json()
+                        self.assertEqual(
+                            z["kubeletconfig"]["topologyCoord"], "1,2")
+                        self.assertEqual(
+                            z["sources"]["topologyCoord"], "override")
+                        self.assertEqual(
+                            z["sources"]["leasePeriodSeconds"], "default")
+                # Registration stamped the mesh coordinate label.
+                node = await store.get("nodes", "n0")
+                from kubernetes_tpu.topology import MESH_COORD_LABEL
+                self.assertEqual(
+                    node["metadata"]["labels"][MESH_COORD_LABEL], "1,2")
+            finally:
+                await server.stop()
+                await agent.stop()
+                store.stop()
+        run(body())
+
+    def test_healthz_reports_stopped(self):
+        async def body():
+            import aiohttp
+            store = new_cluster_store()
+            install_core_validation(store)
+            tmp = tempfile.mkdtemp(prefix="ktpu-seams-")
+            agent = NodeAgent(store, "n0", checkpoint_dir=tmp)
+            await agent.start()
+            server = AgentServer(agent)
+            await server.start()
+            base = f"http://127.0.0.1:{server.port}"
+            try:
+                await agent.stop()
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(base + "/healthz") as r:
+                        self.assertEqual(r.status, 500)
+            finally:
+                await server.stop()
+                store.stop()
+        run(body())

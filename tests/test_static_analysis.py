@@ -319,7 +319,8 @@ class TestFlagRegistry:
         check greps for: KTPU_SERVING, KTPU_CLASS_PLANES,
         KTPU_WAVEFRONT, KTPU_PALLAS, KTPU_WAVE_WIDTH, KTPU_SOLVE_MODE,
         KTPU_SINKHORN_ITERS, KTPU_SINKHORN_TEMP, KTPU_DESCHEDULER,
-        KTPU_DESCHEDULER_BUDGET, KTPU_WATCH_CACHE,
+        KTPU_DESCHEDULER_BUDGET, KTPU_TOPOLOGY, KTPU_MESH_SHAPE,
+        KTPU_WATCH_CACHE,
         KTPU_POLICY_INDEX, KTPU_SHARDS,
         KTPU_SHARD_THRESHOLD, KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH,
         KTPU_SHORTLIST_K, KTPU_ADMISSION_WINDOW,
@@ -338,6 +339,8 @@ class TestFlagRegistry:
             "KTPU_SINKHORN_TEMP": 0.05,
             "KTPU_DESCHEDULER": False,
             "KTPU_DESCHEDULER_BUDGET": 8,
+            "KTPU_TOPOLOGY": True,
+            "KTPU_MESH_SHAPE": "auto",
             "KTPU_WATCH_CACHE": True,
             "KTPU_POLICY_INDEX": True,
             "KTPU_SHARDS": None,
@@ -363,7 +366,8 @@ class TestFlagRegistry:
         kills = {n for n, f in flags.FLAGS.items() if f.kill_switch}
         assert kills == {"KTPU_SERVING", "KTPU_CLASS_PLANES",
                          "KTPU_WAVEFRONT", "KTPU_PALLAS",
-                         "KTPU_SOLVE_MODE", "KTPU_WATCH_CACHE",
+                         "KTPU_SOLVE_MODE", "KTPU_TOPOLOGY",
+                         "KTPU_WATCH_CACHE",
                          "KTPU_POLICY_INDEX", "KTPU_SHARDS",
                          "KTPU_PROCESSES", "KTPU_WAL"}
 

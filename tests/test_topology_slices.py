@@ -1,0 +1,244 @@
+"""Topology slice allocator (kubernetes_tpu/topology): device kernel
+vs host oracle.
+
+Pins: (a) the device scan's feasibility, fragmentation and coverage
+planes are BIT-IDENTICAL to the host oracle over randomized free masks
+across 2D/3D, torus/walled meshes; (b) the packed winner key decodes
+to exactly the oracle's argmin (min fragmentation, lowest placement id
+on ties); (c) the sharded winner reduction agrees at shard counts
+{1, 4, 8} — the key encodes the tie-break, so a distributed max IS the
+argmin; (d) torus wraparound placements exist exactly when wrap is on;
+(e) the mesh model: coordinate labels win over the name-index
+fallback, malformed labels go off-mesh, cell collisions resolve to the
+lowest node index.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.topology.device import (
+    best_key,
+    decode_key,
+    device_scan,
+    fragmentation_pct,
+    frag_cap,
+)
+from kubernetes_tpu.topology.mesh import (
+    MESH_COORD_LABEL,
+    MeshSpec,
+    node_cell,
+    orientations,
+    parse_coord_label,
+    parse_mesh_shape,
+)
+from kubernetes_tpu.topology.slices import (
+    best_placement,
+    coverage,
+    is_contiguous_slice,
+    oracle_scan,
+    placement_members,
+)
+
+#: (dims, wrap) mesh configs spanning 2D/3D, torus/walled.
+CONFIGS = [
+    ((4, 4, 1), True),
+    ((4, 4, 1), False),
+    ((3, 4, 2), True),
+    ((2, 3, 4), False),
+]
+#: slice shapes per trial (normalize pads to 3-tuples).
+SHAPES = [(1, 1, 1), (2, 2, 1), (1, 3, 1), (2, 2, 2)]
+
+
+def _random_free(rng, spec, p=0.6):
+    return rng.random(spec.cells) < p
+
+
+class TestDifferential:
+    def test_device_matches_oracle_randomized(self):
+        rng = np.random.default_rng(1234)
+        trials = 0
+        for dims, wrap in CONFIGS:
+            spec = MeshSpec(dims, wrap)
+            for shape in SHAPES:
+                if any(s > d for s, d in
+                       zip(sorted(shape), sorted(dims))):
+                    continue  # no orientation fits — separate test
+                for _ in range(5):
+                    free = _random_free(rng, spec)
+                    out = device_scan(free, spec, shape)
+                    assert out is not None
+                    key, feas_d, frag_d, cov_d = out
+                    feas_h, frag_h = oracle_scan(free, spec, shape)
+                    np.testing.assert_array_equal(feas_d, feas_h)
+                    np.testing.assert_array_equal(frag_d, frag_h)
+                    np.testing.assert_array_equal(
+                        cov_d, coverage(feas_h, spec, shape))
+                    pid_d, fr_d = decode_key(
+                        best_key(key, 1), spec, shape)
+                    pid_h = best_placement(feas_h, frag_h)
+                    assert pid_d == pid_h
+                    if pid_h >= 0:
+                        assert fr_d == int(frag_h[pid_h])
+                    trials += 1
+        assert trials >= 60
+
+    def test_sharded_winner_parity(self):
+        """The distributed max agrees with the host argmin at shard
+        counts {1, 4, 8} — ties included, since the key packs the
+        lowest-pid tie-break into its low digits."""
+        rng = np.random.default_rng(77)
+        spec = MeshSpec((4, 4, 1), True)
+        for _ in range(6):
+            free = _random_free(rng, spec)
+            out = device_scan(free, spec, (2, 2))
+            assert out is not None
+            key = out[0]
+            want = best_placement(*oracle_scan(free, spec, (2, 2)))
+            for shards in (1, 4, 8):
+                pid, _ = decode_key(best_key(key, shards), spec, (2, 2))
+                assert pid == want, f"shards={shards}"
+
+    def test_fully_free_and_fully_occupied(self):
+        spec = MeshSpec((4, 4, 1), True)
+        free = np.ones(spec.cells, dtype=bool)
+        key, feas, frag, cov = device_scan(free, spec, (2, 2))
+        assert feas.all() and cov.all()
+        assert fragmentation_pct(free, cov) == 0.0
+        occupied = np.zeros(spec.cells, dtype=bool)
+        key2, feas2, _, cov2 = device_scan(occupied, spec, (2, 2))
+        assert not feas2.any()
+        pid, _ = decode_key(best_key(key2, 1), spec, (2, 2))
+        assert pid == -1
+        # no free cells at all → vacuous 0, not NaN
+        assert fragmentation_pct(occupied, cov2) == 0.0
+
+
+class TestWraparound:
+    def test_slice_exists_only_via_torus_wrap(self):
+        # Ring of 8, free run {6, 7, 0}: a 3-slice must wrap.
+        free = np.zeros(8, dtype=bool)
+        free[[6, 7, 0]] = True
+        torus = MeshSpec((8, 1, 1), True)
+        walled = MeshSpec((8, 1, 1), False)
+        pid_t = best_placement(*oracle_scan(free, torus, (3,)))
+        pid_w = best_placement(*oracle_scan(free, walled, (3,)))
+        assert pid_t >= 0 and pid_w == -1
+        assert sorted(c % 8 for c in placement_members(
+            pid_t, torus, (3,))) == [0, 6, 7]
+        # Device side agrees on both.
+        for spec, want in ((torus, pid_t), (walled, -1)):
+            out = device_scan(free, spec, (3,))
+            assert out is not None
+            pid, _ = decode_key(best_key(out[0], 1), spec, (3,))
+            assert pid == want
+
+    def test_wrap_axis_full_span_has_no_exposed_faces(self):
+        # A slice spanning the whole wrap axis has no boundary there:
+        # its fragmentation must be strictly below the walled twin's
+        # cap-relative cost for the same geometry.
+        torus = MeshSpec((4, 2, 1), True)
+        free = np.ones(torus.cells, dtype=bool)
+        _, frag = oracle_scan(free, torus, (4, 1))
+        key, _, frag_d, _ = device_scan(free, torus, (4, 1))
+        np.testing.assert_array_equal(frag_d, frag)
+        assert frag.max() < frag_cap((4, 1, 1))
+
+
+class TestContiguity:
+    def test_members_of_placement_are_contiguous(self):
+        spec = MeshSpec((4, 4, 1), True)
+        free = np.ones(spec.cells, dtype=bool)
+        feas, frag = oracle_scan(free, spec, (2, 2))
+        pid = best_placement(feas, frag)
+        cells = placement_members(pid, spec, (2, 2))
+        assert len(cells) == 4
+        assert is_contiguous_slice(cells, spec, (2, 2))
+
+    def test_scattered_cells_are_not_a_slice(self):
+        spec = MeshSpec((4, 4, 1), True)
+        # Diagonal: right count, wrong geometry.
+        assert not is_contiguous_slice(
+            [0, 5, 10, 15], spec, (2, 2))
+        # Wrong count.
+        assert not is_contiguous_slice([0, 1, 4], spec, (2, 2))
+
+    def test_rotated_slice_is_contiguous(self):
+        spec = MeshSpec((4, 4, 1), False)
+        # A 1x3 run laid out along axis 0 (cells 0, 4, 8): the (3, 1)
+        # orientation of the same shape.
+        assert is_contiguous_slice([0, 4, 8], spec, (1, 3))
+
+
+class TestMeshModel:
+    def test_parse_mesh_shape(self):
+        spec = parse_mesh_shape("4x8", 32)
+        assert spec.dims == (4, 8, 1) and spec.wrap
+        spec = parse_mesh_shape("2x3x4:mesh", 24)
+        assert spec.dims == (2, 3, 4) and not spec.wrap
+        # auto: near-square 2D torus sized to the fleet.
+        spec = parse_mesh_shape("auto", 64)
+        assert spec.cells >= 64 and spec.wrap
+        # malformed degrades to auto, never raises.
+        assert parse_mesh_shape("bogus", 16).cells >= 16
+
+    def test_coord_label_wins_over_name(self):
+        spec = MeshSpec((4, 4, 1), True)
+        cell = node_cell("node-0", {MESH_COORD_LABEL: "2,3"}, spec)
+        assert cell == spec.index_of((2, 3, 0))
+
+    def test_name_index_fallback(self):
+        spec = MeshSpec((4, 4, 1), True)
+        assert node_cell("node-7", {}, spec) == 7
+        assert node_cell("rack2-node-11", {}, spec) == 11
+        # No trailing integer, out-of-range index → off-mesh.
+        assert node_cell("gateway", {}, spec) is None
+        assert node_cell("node-99", {}, spec) is None
+
+    def test_malformed_label_goes_off_mesh(self):
+        spec = MeshSpec((4, 4, 1), True)
+        # Explicit-but-invalid label: off-mesh, NOT the name fallback
+        # (a mislabeled node must not silently claim a cell).
+        assert node_cell("node-3", {MESH_COORD_LABEL: "9,9"}, spec) is None
+        assert node_cell("node-3", {MESH_COORD_LABEL: "x,y"}, spec) is None
+
+    def test_parse_coord_label(self):
+        assert parse_coord_label("1,2") == (1, 2, 0)
+        assert parse_coord_label("1,2,3") == (1, 2, 3)
+        assert parse_coord_label("nope") is None
+
+    def test_cell_collision_lowest_node_index_wins(self):
+        from kubernetes_tpu.topology.planes import TopologyPlanes
+
+        class _N:
+            def __init__(self, name, labels):
+                self.name, self.labels = name, labels
+
+        spec = MeshSpec((2, 2, 1), True)
+        nodes = [_N("a", {MESH_COORD_LABEL: "0,0"}),
+                 _N("b", {MESH_COORD_LABEL: "0,0"}),
+                 _N("c", {MESH_COORD_LABEL: "0,1"})]
+        planes = TopologyPlanes(spec, nodes, n_pad=4,
+                                fingerprint=("t",))
+        assert planes.cell_of_node[0] == 0
+        assert planes.cell_of_node[1] == -1   # later claimant off-mesh
+        assert planes.node_of_cell[0] == 0
+        assert planes.on_mesh == 2
+
+    def test_orientations_dedup_and_fit(self):
+        spec = MeshSpec((4, 4, 1), True)
+        # A square shape has one distinct orientation; a 1x3 has two
+        # in-plane; nothing taller than the mesh fits.
+        assert len(orientations((2, 2), spec)) == 1
+        assert len(orientations((1, 3), spec)) == 2
+        assert orientations((5, 1), spec) == ()
+
+
+class TestOverflowGuard:
+    def test_wide_mesh_key_overflow_returns_none(self):
+        # cap * (A + 1) >= 2**31 → the packed int32 key cannot encode
+        # the tie-break; device_scan must hand back None so the caller
+        # falls back to the host oracle (never a silent wrong winner).
+        spec = MeshSpec((256, 256, 128), True)
+        free = np.ones(spec.cells, dtype=bool)
+        assert device_scan(free, spec, (8, 8, 8)) is None
