@@ -562,6 +562,28 @@ class SchedulerMetrics:
         self.solver_shortlist_fallbacks = r.counter(
             "scheduler_tpu_solver_shortlist_fallbacks_total",
             "Pods whose shortlist bound check fell back to the full row")
+        #: Block-sparse index observability: the two-pass prefilter's
+        #: O(C·B) bound scan always walks every (class, block) pair —
+        #: that is `scanned`; `pruned` counts the pairs whose columns
+        #: the gather pass then NEVER touched because the block's score
+        #: upper bound provably lost to the (K+1)-th shortlist value
+        #: (prune rate = pruned/scanned; 0 on chunks where the
+        #: exactness predicate forced the full-width prefilter). The
+        #: refresh histogram is the serving tier's incremental
+        #: per-block aggregate maintenance wall — O(changed blocks)
+        #: per snapshot refresh, same dirty set as the resident planes.
+        self.solver_blocks_scanned = r.counter(
+            "scheduler_tpu_solver_blocks_scanned_total",
+            "(class, block) pairs walked by the block-bound prefilter "
+            "scan")
+        self.solver_blocks_pruned = r.counter(
+            "scheduler_tpu_solver_blocks_pruned_total",
+            "(class, block) pairs the bound scan proved losers — their "
+            "columns skipped the chunk-start score pass")
+        self.solver_block_refresh = r.histogram(
+            "scheduler_tpu_solver_block_refresh_seconds",
+            "Wall time of one incremental block-aggregate refresh "
+            "(dirty blocks only) on the resident planes")
         #: Wavefront-solve observability (r18): the wave width the latest
         #: chunk solved at (1 = serial scan — kill switch or narrowed
         #: policy), pods committed speculatively, and pods that fell

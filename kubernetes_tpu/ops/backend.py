@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import time
 from functools import partial
 from typing import Mapping, Sequence
@@ -237,6 +238,13 @@ class AdaptiveTuner:
     SHORTLIST_FALLBACK_RATIO = 0.25
     #: minimum solved pods before the fallback rate is trusted.
     SHORTLIST_MIN_SAMPLE = 512
+    #: Block-index width (the two-pass block-sparse prefilter — see
+    #: block_width()): node columns per aggregate block. 128 keeps the
+    #: bound scan O(C·N/128) while M = 2·ceil((K+1)/128) selected
+    #: blocks re-gather ~2K+ columns — comfortably inside the regime
+    #: where the full (C,N) chunk-start pass is the measured wall
+    #: (N ≥ LARGE_N with shortlist active).
+    BLOCK_WIDTH = 128
     #: Wavefront policy rows (the r18 speculative solve): W pods per
     #: scan step, swept at the 5k/50k/200k presets (BASELINE r18). The
     #: win GROWS with node count — the scan-length cut frees the XLA
@@ -293,6 +301,10 @@ class AdaptiveTuner:
     #: too-conservative seed suppressed the fast path before any
     #: sample could land and the suppression was self-sustaining.
     FAST_PATH_SEED_SOLVE_S = 1e-3
+    #: node count the 1 ms solve seed was measured at; an unmeasured
+    #: fast wall seeds at SEED_SOLVE_S x (n / CALIB_N) because solve_one
+    #: is a full-N scan (see _fast_wall_seed).
+    FAST_PATH_SEED_CALIB_N = 5000
     FAST_PATH_CAP_MIN = 8
     FAST_PATH_CAP_MAX = 512
     #: Batch-optimal (Sinkhorn) routing policy row (r20): `auto`
@@ -388,7 +400,19 @@ class AdaptiveTuner:
         to greedy with the fallback bit set so
         solver_optimal_fallbacks_total records it. Under 'auto' the
         optimal mode engages for gang chunks and for chunks of at least
-        OPTIMAL_MIN_PODS real pods (drain/rollout waves)."""
+        OPTIMAL_MIN_PODS real pods (drain/rollout waves) — EXCEPT at
+        the structural large-N row (n_nodes >= LARGE_N, the same signal
+        as the chunk/W/block-width rows), where non-gang chunks keep
+        the greedy scan: the Sinkhorn plan is a fixed
+        KTPU_SINKHORN_ITERS dense (C,N) passes per chunk, so above
+        LARGE_N the plan itself is the linear-in-N solve wall the block
+        index removes (measured @ 200k: ~20 s/chunk optimal vs < 1 s
+        greedy with the block-sparse prefilter) — the latency-budget
+        rationale that routes drains optimal inverts. Gang chunks
+        still route optimal at ANY node count (all-or-nothing
+        placement is where greedy's myopia strands feasible gangs),
+        and KTPU_SOLVE_MODE=optimal still pins every eligible chunk
+        (the policy row only shapes 'auto')."""
         raw = flags.get("KTPU_SOLVE_MODE")
         if raw == "greedy":
             return "greedy", False
@@ -396,6 +420,8 @@ class AdaptiveTuner:
         if raw == "optimal":
             return ("optimal", False) if eligible else ("greedy", True)
         if not (has_gang or p_real >= self.OPTIMAL_MIN_PODS):
+            return "greedy", False
+        if not has_gang and self.n_nodes >= self.LARGE_N:
             return "greedy", False
         return ("optimal", False) if eligible else ("greedy", True)
 
@@ -448,22 +474,40 @@ class AdaptiveTuner:
         return max(1, min(w // self.wave_shrink, chunk))
 
     @classmethod
-    def fast_path_cap(cls, chunk_wall_s: float, fast_wall_s: float) -> int:
+    def _fast_wall_seed(cls, n_nodes: int) -> float:
+        """Unmeasured-wall seed for the fast-path gates. The 1 ms base
+        is the measured 5k-node solve_one wall; the wall is a full-N
+        scan, so the seed scales linearly from that calibration point
+        (200k → 40 ms). Without the scaling, a cold estimate at large N
+        reads the serial drain ~100× too fast, opens the cap to its
+        512 clamp, and one big dispatch serial-drains at ~125 ms/pod
+        while the self-throttled wire hides the pressure from the
+        mid-drain abort (measured: 243 pods, +30 s of 200k drain
+        window)."""
+        return cls.FAST_PATH_SEED_SOLVE_S \
+            * max(1, n_nodes / cls.FAST_PATH_SEED_CALIB_N)
+
+    @classmethod
+    def fast_path_cap(cls, chunk_wall_s: float, fast_wall_s: float,
+                      n_nodes: int = 0) -> int:
         """Largest dispatch the serving tier drains pod-by-pod through
-        the fast path — pure policy over the two measured walls."""
+        the fast path — pure policy over the two measured walls (the
+        node count only shapes the seed while the fast wall is still
+        unmeasured)."""
         if fast_wall_s <= 0:
-            fast_wall_s = cls.FAST_PATH_SEED_SOLVE_S
+            fast_wall_s = cls._fast_wall_seed(n_nodes)
         if chunk_wall_s <= 0:
             chunk_wall_s = cls.FAST_PATH_SEED_CHUNK_S
         return int(min(max(chunk_wall_s / fast_wall_s,
                            cls.FAST_PATH_CAP_MIN), cls.FAST_PATH_CAP_MAX))
 
     @classmethod
-    def fast_path_rate_limit(cls, fast_wall_s: float) -> float:
+    def fast_path_rate_limit(cls, fast_wall_s: float,
+                             n_nodes: int = 0) -> float:
         """Highest estimated offered rate (pods/s) the serving tier
         still serial-drains at — pure policy over the measured wall."""
         if fast_wall_s <= 0:
-            fast_wall_s = cls.FAST_PATH_SEED_SOLVE_S
+            fast_wall_s = cls._fast_wall_seed(n_nodes)
         return cls.FAST_PATH_UTILIZATION / fast_wall_s
 
     @classmethod
@@ -487,6 +531,36 @@ class AdaptiveTuner:
         if n_real < self.SHORTLIST_FACTOR * (k + chunk):
             return 0
         return k
+
+    def block_width(self, n_pad: int, n_real: int, shortlist_k: int) -> int:
+        """Block width for the two-pass block-sparse prefilter, 0 = the
+        full-width r18/r21 prefilter (the structural kill-switch shape).
+
+        Policy: the block index only composes with an active shortlist
+        (it prunes the shortlist prefilter's own O(C·N) pass — without a
+        threshold there is nothing to bound against), and only where the
+        node count is the wall it was built for — n_real ≥ LARGE_N, the
+        same STRUCTURAL signal as the large-N chunk and wavefront rows,
+        so it lands on the first assign with no mid-measured-phase
+        recompile. Below that the bound scan plus gather costs more than
+        the pruned chunk-start pass saves (the shortlist's own 5k
+        lesson, one level up). The M+1 ≤ B shape guard routes 0 for any
+        width/N combination where the selection could not even leave one
+        block unselected (top_k needs M+1 distinct blocks; a fully-
+        selected index prunes nothing). KTPU_BLOCK_WIDTH overrides the
+        width (0 disabling, like the KTPU_BLOCK_INDEX kill switch).
+        """
+        if not flags.get("KTPU_BLOCK_INDEX"):
+            return 0
+        override = flags.get("KTPU_BLOCK_WIDTH")
+        bw = self.BLOCK_WIDTH if override is None else override
+        if bw <= 0 or shortlist_k <= 0 or n_real < self.LARGE_N:
+            return 0
+        b = -(-n_pad // bw)
+        m = 2 * (-(-(shortlist_k + 1) // bw))
+        if m + 1 > b:
+            return 0
+        return bw
 
     def decide(self) -> tuple[int, int] | None:
         """The (chunk, depth) to apply, or None while still warming up.
@@ -634,7 +708,8 @@ def _solve_program():
             _SOLVE_PROGRAM = partial(
                 jax.jit,
                 static_argnames=("strategy", "use_spread", "shortlist_k",
-                                 "wave_w", "solve_mode", "pallas"),
+                                 "wave_w", "solve_mode", "pallas",
+                                 "block_w"),
                 donate_argnums=(1,))(_mask_solve_update.__wrapped__)
     return _SOLVE_PROGRAM
 
@@ -647,10 +722,11 @@ def _donation_live() -> bool:
 
 def solve_provenance() -> dict:
     """Solve-backend provenance for bench/perf output: which jax
-    platform and device count produced a number, and whether the wave
-    solve routes pallas/scan and donates its carry — so CPU-jax rows
-    and relay rows can never be conflated in BASELINE again (the
-    BENCH_r05 attribution gap). Resolves the same policy the router
+    platform, device count and host core count produced a number, and
+    whether the wave solve routes pallas/scan and donates its carry —
+    so CPU-jax rows, single-core container rows and relay rows can
+    never be conflated in BASELINE again (the BENCH_r05 attribution
+    gap, and r22's single-core premise note, as data in every JSON). Resolves the same policy the router
     applies to an eligible greedy wave chunk; per-chunk structural
     fallbacks can still keep individual chunks on the scan (counted in
     solver_pallas_fallbacks_total)."""
@@ -667,6 +743,7 @@ def solve_provenance() -> dict:
     return {
         "jax_platform": platform,
         "jax_device_count": jax.device_count(),
+        "cpu_count": os.cpu_count(),
         "solve_kernel": "scan" if resolved == "off" else "pallas",
         "pallas_mode": resolved,
         "pallas_flag": raw,
@@ -691,7 +768,7 @@ def _signature(plugin_name: str, pi: PodInfo) -> str:
 
 @partial(jax.jit,
          static_argnames=("strategy", "use_spread", "shortlist_k",
-                          "wave_w", "solve_mode", "pallas"))
+                          "wave_w", "solve_mode", "pallas", "block_w"))
 def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        cls_idx, exc_col,
                        taint_f_mat, taint_p_mat, class_mask, class_scores,
@@ -700,10 +777,10 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
                        dom_onehot, cid_onehot, dom_counts, max_skew,
                        sp_min_ok, sp_haskey,
                        sp_applies, sp_contrib, perms, gang_onehot,
-                       gang_required, sink_iters, sink_temp,
+                       gang_required, sink_iters, sink_temp, n_real,
                        strategy: str, use_spread: bool, shortlist_k: int,
                        wave_w: int, solve_mode: str = "greedy",
-                       pallas: str = "off"):
+                       pallas: str = "off", block_w: int = 0):
     """One fused device pass: plugin masks → scores → assignment → state.
 
     The used-state (used_q ‖ used_nz_q ‖ used_pods, packed into ONE (N,2R+1)
@@ -794,10 +871,21 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     is live, the resident planes' base pack is never passed here
     directly (the serving seed is copied first; see _start).
 
-    Returns (assign (P+3,) — the tail is [shortlist fallbacks, wave
-    commits, wave replays] riding the one fetch — used_pack', fit0
-    (C,N), taint_ok (C,N), dom_counts'). The diagnostic planes are
-    CLASS-level; consumers gather through cls_idx host-side.
+    `block_w > 0` (static, part of the program key) swaps the shortlist
+    prefilter for the TWO-PASS BLOCK-SPARSE form (ops/solver.py
+    `block_bound_prefilter`): per-block aggregate bounds gate which node
+    columns the chunk-start score pass touches, exactly — an in-program
+    lax.cond falls back to the full-width pass whenever the bound
+    predicate cannot prove the gathered top-K global. `n_real` (traced)
+    excludes bucket-padding columns from the aggregates. block_w == 0 is
+    the KTPU_BLOCK_INDEX kill-switch shape: the full-width r18/r21
+    prefilter call graph, structurally.
+
+    Returns (assign (P+5,) — the tail is [shortlist fallbacks, wave
+    commits, wave replays, blocks scanned, blocks pruned] riding the one
+    fetch — used_pack', fit0 (C,N), taint_ok (C,N), dom_counts'). The
+    diagnostic planes are CLASS-level; consumers gather through cls_idx
+    host-side.
     """
     # Wire decompression (see _prep_chunk): masks arrive bit-packed
     # uint8 (C, N/8) big-endian, scores float16 — unpack/cast on device
@@ -842,6 +930,8 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
     nfall = jnp.int32(0)
     wave_com = jnp.int32(0)
     wave_rep = jnp.int32(0)
+    blk_scanned = jnp.int32(0)
+    blk_pruned = jnp.int32(0)
     n_pad = alloc_q.shape[0]
     if solve_mode == "optimal" and not use_spread:
         # Batch-optimal mode (see docstring): transport plan over the
@@ -868,12 +958,29 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
         # only decreases within a chunk); spread gating deliberately
         # does not (it is non-monotone and exact in-scan — see the
         # spread solver).
-        sc0 = kernels.chunk_start_scores(
-            alloc_q, used_nz_q, c_req_nz_q, static_scores,
-            fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
-            strategy)
-        cand_s, thresh_s = solver.shortlist_prefilter(
-            feasible, sc0, shortlist_k)
+        # block_w > 0 routes the TWO-PASS BLOCK-SPARSE form: an O(C·B)
+        # per-block bound scan gates which columns the chunk-start pass
+        # touches, falling back to the full-width pass in-program
+        # whenever its exactness predicate cannot prove the gathered
+        # top-K global (solver.block_bound_prefilter). Static routing:
+        # block_w is part of the fused-program key like wave_w, and 0
+        # (the KTPU_BLOCK_INDEX kill switch / small-N tuner decision /
+        # M+1 > B shape guard) traces the r18/r21 full-width call graph
+        # verbatim.
+        if block_w > 0:
+            sc0, cand_s, thresh_s, blk_scanned, blk_pruned = \
+                solver.block_bound_prefilter(
+                    alloc_q, used_nz_q, c_req_nz_q, static_scores,
+                    feasible, fit_col_w, bal_col_mask, shape_u, shape_s,
+                    w_fit, w_bal, strategy, n_real, shortlist_k,
+                    block_w)
+        else:
+            sc0 = kernels.chunk_start_scores(
+                alloc_q, used_nz_q, c_req_nz_q, static_scores,
+                fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                strategy)
+            cand_s, thresh_s = solver.shortlist_prefilter(
+                feasible, sc0, shortlist_k)
         sl_cand = cand_s[cls_idx]                               # (P, K)
         sl_thresh = thresh_s[cls_idx]                           # (P,)
         # has_node: class-level any(), narrowed to the pinned column for
@@ -974,10 +1081,12 @@ def _mask_solve_update(alloc_q, used_pack, alloc_pods, class_pack,
         (n + 1, used_pack.shape[1]), used_pack.dtype
     ).at[tgt].add(jnp.where(hit[:, None], inc, 0))[:n]
     # The observability tail rides the assign fetch (one transfer, not
-    # four): consumers slice [:p_real] for assignments, then [-3] =
-    # shortlist fallbacks, [-2]/[-1] = wavefront commits/replays.
+    # six): consumers slice [:p_real] for assignments, then [-5] =
+    # shortlist fallbacks, [-4]/[-3] = wavefront commits/replays,
+    # [-2]/[-1] = block-prefilter blocks scanned/pruned.
     assign_out = jnp.concatenate(
-        [assign, nfall[None], wave_com[None], wave_rep[None]])
+        [assign, nfall[None], wave_com[None], wave_rep[None],
+         blk_scanned[None], blk_pruned[None]])
     return assign_out, used_pack2, fit0, taint_ok, dom_counts2
 
 
@@ -2642,6 +2751,15 @@ class TPUBackend:
         if flags.get("KTPU_WAVEFRONT"):
             wave_w = self._tuner.wave_width(P)
 
+        # Block-index width: the two-pass block-sparse prefilter rides
+        # the shortlist (it prunes the prefilter's own O(C·N) pass), so
+        # it activates only with it — the tuner's structural large-N
+        # row plus the KTPU_BLOCK_INDEX/KTPU_BLOCK_WIDTH knobs. 0 is
+        # the full-width prefilter, structurally (a static arg of the
+        # fused program, part of the chunk program key like W and K).
+        block_w = self._tuner.block_width(
+            ct.n_pad, ct.n_real, shortlist_k) if shortlist_k else 0
+
         # Multi-start orders: identity first (ties → oracle-equivalent),
         # then size-desc / size-asc / seeded shuffles. Permutations are
         # PRIORITY-BLOCK-STABLE: pods only move within runs of equal
@@ -2751,6 +2869,7 @@ class TPUBackend:
             "gang_required": gang_required,
             "shortlist_k": shortlist_k,
             "wave_w": wave_w,
+            "block_w": block_w,
             "class_mode": class_reps is not None,
             "scan_width": (shortlist_k + P) if shortlist_k else ct.n_real,
         }
@@ -2829,6 +2948,10 @@ class TPUBackend:
         if solve_mode == "optimal":
             prep["shortlist_k"] = 0
             prep["wave_w"] = 0
+        # The block index rides the shortlist; any route that zeroed K
+        # (optimal mode) zeroes the block width with it.
+        if not prep["shortlist_k"]:
+            prep["block_w"] = 0
         prep["solve_mode"] = solve_mode
         prep["optimal_fallback"] = opt_fallback
         # Pallas routing (the KTPU_PALLAS policy row + structural shape
@@ -2866,8 +2989,10 @@ class TPUBackend:
                 prep["dev_perms"], *self._gang_args(prep, batch),
                 np.int32(max(1, flags.get("KTPU_SINKHORN_ITERS"))),
                 np.float32(flags.get("KTPU_SINKHORN_TEMP")),
+                np.int32(ct.n_real),
                 p["strategy"], use_spread, prep["shortlist_k"],
                 prep["wave_w"], solve_mode, pallas_mode,
+                prep["block_w"],
             )
         self._dev_used = used_pack2
         if use_spread:
@@ -2889,14 +3014,18 @@ class TPUBackend:
         assign = assign_np[: batch.p_real]
 
         # Solve-side observability: the fused program appends the chunk's
-        # [shortlist fallbacks, wave commits, wave replays] tail to the
-        # assign vector (one fetch). The tuner's hit-rate feedback widens
-        # K when fallbacks climb and narrows W when replays climb. A
-        # poisoned multistart chunk reports the PADDED width — clamp to
-        # real pods so rates never exceed 100%.
-        nfall = min(int(assign_np[-3]), batch.p_real)
-        wave_com = min(int(assign_np[-2]), batch.p_real)
-        wave_rep = min(int(assign_np[-1]), batch.p_real)
+        # [shortlist fallbacks, wave commits, wave replays, blocks
+        # scanned, blocks pruned] tail to the assign vector (one fetch).
+        # The tuner's hit-rate feedback widens K when fallbacks climb and
+        # narrows W when replays climb. A poisoned multistart chunk
+        # reports the PADDED width — clamp to real pods so rates never
+        # exceed 100%. The block counters are (class, block) pair counts,
+        # not pod counts — no clamp.
+        nfall = min(int(assign_np[-5]), batch.p_real)
+        wave_com = min(int(assign_np[-4]), batch.p_real)
+        wave_rep = min(int(assign_np[-3]), batch.p_real)
+        blk_scanned = int(assign_np[-2])
+        blk_pruned = int(assign_np[-1])
         if run.get("shortlist_k"):
             self._tuner.observe_solve(batch.p_real, nfall)
         if run.get("wave_w", 0) > 1:
@@ -2912,6 +3041,18 @@ class TPUBackend:
                 self.metrics.solver_shortlist_pods.inc(batch.p_real)
                 if nfall:
                     self.metrics.solver_shortlist_fallbacks.inc(nfall)
+            # Block-prefilter accounting: scanned counts every (class,
+            # block) pair the bound scan walked for chunks routed with
+            # block_w > 0; pruned counts the pairs the exactness
+            # predicate proved losers (0 for a chunk whose predicate
+            # fell back full-width in-program). block_w == 0 chunks
+            # report neither — the zero-counter structural degrade the
+            # smoke test pins.
+            if run.get("block_w"):
+                if blk_scanned:
+                    self.metrics.solver_blocks_scanned.inc(blk_scanned)
+                if blk_pruned:
+                    self.metrics.solver_blocks_pruned.inc(blk_pruned)
             # Optimal-mode accounting (r20): solves count CHUNKS routed
             # through the Sinkhorn plan; fallbacks count chunks the
             # policy WANTED optimal but structure (spread / per-pod

@@ -15,9 +15,24 @@ applied by the backend when summing.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 MAX_NODE_SCORE = 100.0
+
+#: Absolute slack folded into every block score upper bound. The bound
+#: kernels evaluate the score formulas at per-block interval corners
+#: with the same op sequence as the full kernels, so the REAL-arithmetic
+#: corner dominates every column; the slack absorbs the f32 rounding
+#: divergence between the corner evaluation and the per-column
+#: evaluations (score magnitudes are O(1e3), op chains O(10) deep —
+#: worst-case drift ~4e-3, so 2^-3 is a ≥30× margin). A bound padded up
+#: can only LOSE pruning opportunities, never exactness.
+BLOCK_UB_EPS = 0.125
+
+#: Sentinel for masked-out columns in per-block minima: large enough to
+#: never be a real min, small enough that +req never overflows int32.
+_BLOCK_BIG = 2 ** 30
 
 
 # --- NodeResourcesFit: Filter ------------------------------------------------
@@ -143,6 +158,146 @@ def chunk_start_scores(alloc_q, used_nz_q, req_nz_q, static_scores,
         alloc_q, used_nz_q, req_nz_q, fit_col_w, strategy, shape_u, shape_s)
     return sc + w_bal * balanced_allocation_score(
         alloc_q, used_nz_q, req_nz_q, bal_col_mask)
+
+
+# --- block-sparse node index: aggregates + bounds ---------------------------
+
+def _block_fold(x, block_w: int, fill):
+    """Reshape the leading N axis into (B, block_w, ...) blocks, padding
+    the tail block with `fill` so every aggregate below stays a plain
+    fixed-shape reduce (the N % block_w != 0 case)."""
+    n = x.shape[0]
+    b = -(-n // block_w)
+    pad = b * block_w - n
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)], axis=0)
+    return x.reshape((b, block_w) + x.shape[1:])
+
+
+def block_capacity_aggregates(alloc_q, used_nz_q, col_real, block_w: int):
+    """Per-block capacity interval planes over the REAL node columns:
+    (amin_pos, amin, amax, umin, umax), each (B, R) int32.
+
+    amin/amax/umin/umax are plain real-column min/max of allocatable and
+    scoring-used; amin_pos is the min over columns with alloc > 0 (the
+    only columns the fit mean scores, so it is the right denominator
+    corner for MostAllocated/RTCR utilization), while the plain amin
+    exists for the uniform-block arm of the exactness predicate —
+    amin == amax certifies every real column shares one alloc vector,
+    which amin_pos cannot (it would miss a zero-alloc column hiding
+    among equal nonzero ones). Masked-out columns (padding, alloc == 0
+    for amin_pos) fold in as inert sentinels — a pad-only block ends up
+    with min > max, which no bound below can mistake for a uniform
+    block.
+    """
+    rmask = col_real[:, None]
+    amax = jnp.max(_block_fold(
+        jnp.where(rmask, alloc_q, 0), block_w, 0), axis=1)
+    amin = jnp.min(_block_fold(
+        jnp.where(rmask, alloc_q, _BLOCK_BIG), block_w, _BLOCK_BIG),
+        axis=1)
+    amin_pos = jnp.min(_block_fold(
+        jnp.where(rmask & (alloc_q > 0), alloc_q, _BLOCK_BIG),
+        block_w, _BLOCK_BIG), axis=1)
+    umax = jnp.max(_block_fold(
+        jnp.where(rmask, used_nz_q, 0), block_w, 0), axis=1)
+    umin = jnp.min(_block_fold(
+        jnp.where(rmask, used_nz_q, _BLOCK_BIG), block_w, _BLOCK_BIG),
+        axis=1)
+    return amin_pos, amin, amax, umin, umax
+
+
+def block_feasible_stat(feasible, static_scores, block_w: int):
+    """Per-(class, block) planes of the capacity-independent score over
+    the FEASIBLE columns: (stat_max, stat_min, feas_cnt), each (C, B).
+
+    feas_cnt is the bit-mask popcount per block; stat_max feeds the
+    score upper bound (a block with no feasible column is -inf and can
+    never gate a fallback), stat_min exists for the uniform-block
+    equality arm of the exactness predicate (stat_min == stat_max means
+    every feasible column shares one static score).
+    """
+    masked_max = _block_fold(
+        jnp.where(feasible, static_scores, -jnp.inf).T, block_w, -jnp.inf)
+    masked_min = _block_fold(
+        jnp.where(feasible, static_scores, jnp.inf).T, block_w, jnp.inf)
+    cnt = _block_fold(feasible.T.astype(jnp.int32), block_w, 0)
+    return (jnp.max(masked_max, axis=1).T,
+            jnp.min(masked_min, axis=1).T,
+            jnp.sum(cnt, axis=1).T)
+
+
+def block_score_upper_bound(stat_max, feas_cnt, amin_pos, amax, umin,
+                            umax, req_nz_q, fit_col_w, bal_col_mask,
+                            shape_u, shape_s, w_fit, w_bal,
+                            strategy: str):
+    """(C, B) upper bound on the chunk-start live score of any feasible
+    column in each block — the block-bound scan of the two-pass
+    prefilter.
+
+    Per resource, the strategy score is evaluated at the interval
+    corner that maximizes it (fit_score is monotone per strategy in
+    used and alloc; RTCR additionally checks its piecewise breakpoints
+    inside the utilization interval). The weighted mean over scoring
+    resources is bounded by the max of the per-resource bounds (a
+    weighted average never exceeds the largest capped term — exact for
+    any per-column valid-resource pattern). The balanced-allocation
+    term is bounded by its range cap. BLOCK_UB_EPS absorbs f32 corner
+    rounding; blocks with no feasible column are -inf.
+    """
+    af_min = amin_pos.astype(jnp.float32)[None, :, :]       # (1,B,R)
+    af_max = amax.astype(jnp.float32)[None, :, :]
+    r_lo = (umin[None, :, :] + req_nz_q[:, None, :]).astype(jnp.float32)
+    r_hi = (umax[None, :, :] + req_nz_q[:, None, :]).astype(jnp.float32)
+    safe_max = jnp.where(af_max > 0, af_max, 1.0)
+    safe_min = jnp.where(af_min > 0, af_min, 1.0)
+    if strategy == "MostAllocated":
+        s_ub = jnp.clip(MAX_NODE_SCORE * r_hi / safe_min,
+                        0.0, MAX_NODE_SCORE)
+    elif strategy == "RequestedToCapacityRatio":
+        # Utilization interval corners, widened a hair so fl rounding
+        # cannot shrink the interval past a column's true utilization.
+        # No 100-cap here: fit_score leaves the scaled piecewise value
+        # uncapped, so the bound must not cap it either.
+        u_lo = MAX_NODE_SCORE * r_lo / safe_max - 0.01
+        u_hi = MAX_NODE_SCORE * r_hi / safe_min + 0.01
+        ends = jnp.maximum(_piecewise(u_lo, shape_u, shape_s),
+                           _piecewise(u_hi, shape_u, shape_s))
+        inside = (shape_u >= u_lo[..., None]) & (shape_u <= u_hi[..., None])
+        bps = jnp.max(jnp.where(inside, shape_s, -jnp.inf), axis=-1)
+        s_ub = jnp.maximum(jnp.maximum(ends, bps)
+                           * (MAX_NODE_SCORE / 10.0), 0.0)
+    else:  # LeastAllocated
+        s_ub = jnp.clip(MAX_NODE_SCORE * (af_max - r_lo) / safe_max,
+                        0.0, MAX_NODE_SCORE)
+    scored = (fit_col_w[None, None, :] > 0) & (af_max > 0)
+    fit_ub = jnp.max(jnp.where(scored, s_ub, 0.0), axis=-1)    # (C,B)
+    bal_ub = jnp.where(w_bal > 0, MAX_NODE_SCORE, 0.0)
+    ub = stat_max + w_fit * fit_ub + w_bal * bal_ub + BLOCK_UB_EPS
+    return jnp.where(feas_cnt > 0, ub, -jnp.inf)
+
+
+def gathered_start_scores(alloc_g, used_nz_g, req_nz_q, static_g,
+                          fit_col_w, bal_col_mask, shape_u, shape_s,
+                          w_fit, w_bal, strategy: str):
+    """chunk_start_scores over per-class GATHERED columns: alloc_g and
+    used_nz_g are (C, G, R) per-class gathers of the capacity planes,
+    static_g/req_nz_q the matching (C, G)/(C, R) rows → (C, G) f32.
+
+    One vmapped single-class evaluation of the SAME kernels, the
+    live_scores idiom of the shortlist-wave scan: an untouched gathered
+    column's value is the same arithmetic the full-width pass runs, so
+    the scans' threshold comparisons stay float-consistent with the
+    block-gated prefilter's shortlist values.
+    """
+    def one(alloc_r, used_r, req_r, stat_r):
+        sc = stat_r + w_fit * fit_score(
+            alloc_r, used_r, req_r[None, :], fit_col_w, strategy,
+            shape_u, shape_s)[0]
+        return sc + w_bal * balanced_allocation_score(
+            alloc_r, used_r, req_r[None, :], bal_col_mask)[0]
+    return jax.vmap(one)(alloc_g, used_nz_g, req_nz_q, static_g)
 
 
 # --- TaintToleration: Score --------------------------------------------------

@@ -398,6 +398,171 @@ def shortlist_prefilter(feas0, sc0, k: int):
     return cand[:, :k].astype(jnp.int32), vals[:, k]
 
 
+def block_bound_prefilter(alloc_q, used_nz_q, req_nz_q, static_scores,
+                          feasible, fit_col_w, bal_col_mask, shape_u,
+                          shape_s, w_fit, w_bal, strategy: str, n_real,
+                          k: int, block_w: int):
+    """Two-pass block-sparse form of the shortlist prefilter — the
+    sublinear replacement for the full (C,N) `chunk_start_scores` +
+    `shortlist_prefilter` pass at large N.
+
+    Pass 1 (O(C·B)): fold the N node columns into B = ceil(N/block_w)
+    fixed blocks, derive per-block aggregate planes IN-PROGRAM from the
+    live capacity planes (never from maintained state — a mid-batch
+    verify-reject fold-back decreases `used`, which would turn any
+    chained max/min stale in the unsafe direction), and compute a per-
+    (class, block) score upper bound (kernels.block_score_upper_bound).
+    Select the M = 2·ceil((K+1)/block_w) highest-bound blocks per class.
+
+    Pass 2 (O(C·M·block_w)): gather just the selected blocks' columns,
+    score them with `kernels.gathered_start_scores` (bit-identical
+    element arithmetic to the full pass — every op is element-wise over
+    columns with reductions only over R), and take the per-class top-K
+    + threshold exactly as `shortlist_prefilter` would.
+
+    Exactness gate — the result is used ONLY when, for every class c
+    and every non-selected block b, one arm holds:
+
+    - strict:  ub[c,b] < t̂[c] — the bound (which over-approximates by
+      construction, plus BLOCK_UB_EPS of float slack) already loses to
+      the gathered (K+1)-th value, so no column of b can enter the
+      top-K or move the threshold.
+    - empty:   feas_cnt[c,b] == 0 — no feasible column at all.
+    - uniform: block b lies strictly AFTER the last selected block,
+      block b and that reference block are capacity-uniform and share
+      one static score (exact tuple equality of (stat_max, stat_min,
+      amin, amax, umin, umax) — no epsilon: identical inputs ⇒
+      identical f32 outputs), and the reference block's best gathered
+      value v_ref ≤ t̂. Then every feasible column of b scores exactly
+      v_ref, and its position after the whole selection puts it at a
+      higher global index than every gathered column, so at v_ref == t̂
+      the full-width top_k's lower-index tie rule (see
+      shortlist_prefilter) would still pick the gathered columns —
+      threshold and candidates are bit-identical. This arm is what
+      keeps uniform fleets (every node identical, every bound tied)
+      prunable — the strict arm alone can never separate identical
+      blocks — and because it keys on the last selected block rather
+      than a fixed 0..M-1 prefix, it keeps firing as a drain's usage
+      frontier advances and selection shifts to later blocks (the
+      already-filled blocks behind the frontier prune via strict: their
+      debited scores sit below the fresh-node threshold by more than
+      BLOCK_UB_EPS for any non-trivial request). A uniform block before
+      or between selected blocks cannot use this arm (its columns would
+      WIN the ties) and routes to the fallback.
+
+    When any block fails all arms, the whole chunk falls back to the
+    full-width pass via lax.cond — exact by construction, and the
+    fallback branch traces the r18/r21 call graph verbatim.
+
+    Candidate caveat shared with the full prefilter: when a class has
+    fewer than K feasible columns, the -inf candidate slots may name
+    different (infeasible) columns than the full pass would — inert for
+    the scans, which re-mask candidates against live feasibility.
+
+    n_real: traced int32 — real (unpadded) node count; padding columns
+    are excluded from every aggregate. k/block_w: static.
+
+    Returns (sc0 (C,N) — gathered columns hold their exact chunk-start
+    value, non-gathered columns are 0.0 and only ever read through the
+    candidate set, cand (C,K), thresh (C,), blocks_scanned int32,
+    blocks_pruned int32).
+    """
+    from kubernetes_tpu.ops import kernels  # local to avoid import cycle
+    n = alloc_q.shape[0]
+    c = static_scores.shape[0]
+    bw = block_w
+    b = -(-n // bw)
+    m = 2 * (-(-(k + 1) // bw))
+    if m + 1 > b:
+        raise ValueError(
+            f"block prefilter needs M+1={m + 1} <= B={b}; route "
+            "block_w=0 for this shape (see AdaptiveTuner.block_width)")
+
+    col_real = jnp.arange(n, dtype=jnp.int32) < n_real
+    amin_pos, amin, amax, umin, umax = kernels.block_capacity_aggregates(
+        alloc_q, used_nz_q, col_real, bw)
+    stat_max, stat_min, feas_cnt = kernels.block_feasible_stat(
+        feasible, static_scores, bw)
+    ub = kernels.block_score_upper_bound(
+        stat_max, feas_cnt, amin_pos, amax, umin, umax, req_nz_q,
+        fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+        strategy)                                                # (C,B)
+
+    _, sel = lax.top_k(ub, m + 1)
+    sel_ids = jnp.sort(sel[:, :m].astype(jnp.int32), axis=1)     # (C,M) asc
+    rowi = jnp.arange(c, dtype=jnp.int32)[:, None]
+
+    # Gather the selected blocks' columns. Ascending sel_ids keep the
+    # gathered order a subsequence of global column order, so top_k's
+    # lower-index tie rule below means the same thing it means full-width.
+    cols = (sel_ids[:, :, None] * bw
+            + jnp.arange(bw, dtype=jnp.int32)[None, None, :]).reshape(c, -1)
+    valid = cols < n                    # tail fold-pad beyond the planes
+    safe_cols = jnp.minimum(cols, n - 1)
+    feas_g = jnp.take_along_axis(feasible, safe_cols, axis=1) & valid
+    stat_g = jnp.take_along_axis(static_scores, safe_cols, axis=1)
+    sc0_g = kernels.gathered_start_scores(
+        alloc_q[safe_cols], used_nz_q[safe_cols], req_nz_q, stat_g,
+        fit_col_w, bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+        strategy)                                                # (C,G)
+    masked_g = jnp.where(feas_g, sc0_g, NEG_INF)
+    vals, loc = lax.top_k(masked_g, k + 1)
+    cand = jnp.take_along_axis(
+        safe_cols, loc[:, :k], axis=1).astype(jnp.int32)
+    thresh = vals[:, k]
+
+    # Scatter gathered scores into a full-width sc0 row set the scans
+    # can element-gather from. Invalid (fold-pad) lanes write to the
+    # throwaway column N of an (N+1)-wide buffer — a clamp-to-N-1 write
+    # would clobber the real last column.
+    tgt = jnp.where(valid, cols, n)
+    sc0_full = jnp.zeros((c, n + 1), jnp.float32).at[
+        rowi, tgt].set(sc0_g)[:, :n]
+
+    # --- exactness predicate over non-selected blocks ---
+    is_sel = jnp.zeros((c, b), jnp.bool_).at[rowi, sel_ids].set(True)
+    strict = ub < thresh[:, None]
+    empty = feas_cnt == 0
+
+    ref = sel_ids[:, m - 1:m]                                    # (C,1)
+    unif_cap = (jnp.all(amin == amax, axis=1)
+                & jnp.all(umin == umax, axis=1))[None, :]        # (1,B)
+    stat_unif = stat_max == stat_min                             # (C,B)
+    eq_cap = (jnp.all(amax[None, :, :] == amax[ref], axis=-1)
+              & jnp.all(amin[None, :, :] == amin[ref], axis=-1)
+              & jnp.all(umax[None, :, :] == umax[ref], axis=-1)
+              & jnp.all(umin[None, :, :] == umin[ref], axis=-1))
+    eq_stat = ((stat_max == jnp.take_along_axis(stat_max, ref, axis=1))
+               & (stat_min == jnp.take_along_axis(stat_min, ref, axis=1)))
+    # Only blocks strictly AFTER the last selected block qualify: their
+    # columns all sit at higher global indices than every gathered
+    # column, so ties at t̂ lose top_k's lower-index rule. A uniform
+    # block BEFORE or BETWEEN selected blocks would win those ties —
+    # it must prune via strict/empty or force the fallback.
+    after_ref = jnp.arange(b, dtype=jnp.int32)[None, :] > ref
+    v_ref = jnp.max(masked_g.reshape(c, m, bw)[:, m - 1, :], axis=-1)
+    uniform = (after_ref & unif_cap & stat_unif & eq_cap & eq_stat
+               & (v_ref <= thresh)[:, None])
+
+    ok_all = jnp.all(is_sel | strict | empty | uniform)
+
+    def _block_exact(_):
+        return sc0_full, cand, thresh
+
+    def _block_fallback_full(_):
+        sc0 = kernels.chunk_start_scores(
+            alloc_q, used_nz_q, req_nz_q, static_scores, fit_col_w,
+            bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy)
+        cand_f, thresh_f = shortlist_prefilter(feasible, sc0, k)
+        return sc0, cand_f, thresh_f
+
+    sc0_out, cand_out, thresh_out = lax.cond(
+        ok_all, _block_exact, _block_fallback_full, jnp.int32(0))
+    blocks_scanned = jnp.int32(c * b)
+    blocks_pruned = jnp.where(ok_all, jnp.int32(c * (b - m)), jnp.int32(0))
+    return sc0_out, cand_out, thresh_out, blocks_scanned, blocks_pruned
+
+
 def _shortlist_scan(req_q, req_nz_q, rows, free_q, free_pods, used_nz_q,
                     alloc_q, mask, static_scores, fit_col_w, bal_col_mask,
                     shape_u, shape_s, w_fit, w_bal, strategy: str,

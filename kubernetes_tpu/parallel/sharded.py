@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubernetes_tpu.ops import kernels, pallas_kernel
+from kubernetes_tpu.ops import kernels, pallas_kernel, solver
 from kubernetes_tpu.parallel.mesh import NODES_AXIS, PODS_AXIS, SLICE_AXIS
 
 try:  # jax>=0.8 top-level; fall back for older versions
@@ -64,6 +64,19 @@ _INT_MAX = jnp.int32(2**31 - 1)
 
 _PHASE_CACHE: dict = {}
 _SOLVER_CACHE: dict = {}
+
+
+def _block_w_for(block_w: int, shortlist_k: int, local_n: int) -> int:
+    """Clamp a requested block-index width to a shard-local shape it is
+    valid for: the two-pass prefilter needs M+1 ≤ B over the SHARD'S
+    column count (ops/solver.block_bound_prefilter's static guard — a
+    shard too narrow to leave one block unselected has nothing to
+    prune). 0 keeps the full-width local prefilter, structurally."""
+    if not (block_w and shortlist_k):
+        return 0
+    b = -(-local_n // block_w)
+    m = 2 * (-(-(shortlist_k + 1) // block_w))
+    return block_w if m + 1 <= b else 0
 
 
 # ---------------------------------------------------------------------------
@@ -131,7 +144,8 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
                           w_fit, w_bal, strategy: str,
                           shortlist_k: int = 0, rows=None, exc=None,
                           row_req_q=None, row_req_nz_q=None,
-                          wave_w: int = 0, pallas: bool = False):
+                          wave_w: int = 0, pallas: bool = False,
+                          block_w: int = 0):
     """Sequential-equivalent greedy with live re-scoring, node axis sharded.
 
     Per scan step: shard-local candidate (max score, min index among ties) →
@@ -149,6 +163,17 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
     O(1) scalars; what shrinks is each shard's local reduce, N/devices →
     K/devices + touched. A shard narrower than K+1 columns keeps the full
     local scan (nothing to prune).
+
+    block_w > 0 additionally routes each shard's PREFILTER through the
+    two-pass block-sparse form (ops/solver.block_bound_prefilter) over
+    its own column set: an O(C·B_local) bound scan gates which local
+    columns the chunk-start pass touches, with the in-program full-width
+    fallback whenever the exactness predicate fails — shard-local and
+    collective-free, so the per-step pmax/pmin winner wire is untouched
+    and assignments stay bit-identical at every shard count. A shard
+    whose column count cannot satisfy the M+1 ≤ B_local shape guard
+    keeps the full-width local prefilter (same clamp rule as the
+    backend's tuner row).
 
     pallas=True fuses each wave's shard-local (W, local_n) evaluation —
     plane gather, exception gate, capacity fit, live re-score, feasible
@@ -186,7 +211,8 @@ def sharded_greedy_assign(mesh: Mesh, req_q, req_nz_q, free_q, free_pods,
     k = min(shortlist_k, local_n - 1) if shortlist_k else 0
     run = _solver_fn(mesh, strategy, local_n, shortlist_k=max(k, 0),
                      wave_w=0 if k else max(0, wave_w),
-                     pallas=bool(pallas and not k and wave_w > 1))
+                     pallas=bool(pallas and not k and wave_w > 1),
+                     block_w=_block_w_for(block_w, k, local_n))
     p = req_q.shape[0]
     if rows is None:
         rows = jnp.arange(p, dtype=jnp.int32)
@@ -354,7 +380,7 @@ def _wave_body(mesh, axes, local_n, base, iota, strategy, wave_w,
 def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
                axes: tuple[str, ...] = (NODES_AXIS,),
                shortlist_k: int = 0, wave_w: int = 0,
-               pallas: bool = False):
+               pallas: bool = False, block_w: int = 0):
     """One solver body for every mesh shape: the node dimension shards over
     `axes` (flattened, first axis major). Reductions run innermost-axis
     first, so a (slice, nodes) pair reduces slice-locally over ICI before
@@ -362,7 +388,8 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
     §5.7 falls out of the axis order. wave_w > 1 compiles the wavefront
     wave-step body instead of the one-pod step (mutually exclusive with
     shortlist_k; the caller routes)."""
-    key = (mesh, strategy, local_n, axes, shortlist_k, wave_w, pallas)
+    key = (mesh, strategy, local_n, axes, shortlist_k, wave_w, pallas,
+           block_w)
     fn = _SOLVER_CACHE.get(key)
     if fn is not None:
         return fn
@@ -424,16 +451,34 @@ def _solver_fn(mesh: Mesh, strategy: str, local_n: int,
             # Shard-local prefilter: chunk-start scores over MY columns,
             # per-PLANE-ROW top-K + the (K+1)-th value as the local
             # threshold — C class rows when the caller ships class
-            # planes, P pod rows in the identity form.
+            # planes, P pod rows in the identity form. block_w > 0
+            # routes the two-pass block-sparse form over this shard's
+            # columns: the bound scan, gather, and the in-program
+            # full-width fallback are all shard-LOCAL (no collective —
+            # shards may even take different cond branches), and local
+            # padding columns are handled by feasibility alone
+            # (n_real = local_n: a looser bound for a block holding
+            # global pad columns can only cost pruning, never
+            # exactness). Local-index tie rules line up exactly because
+            # the gather preserves ascending local column order.
             fits0 = jnp.all(row_req_q[:, None, :] <= free_q[None, :, :],
                             axis=-1) & (free_pods >= 1)[None, :]
-            sc0 = kernels.chunk_start_scores(
-                alloc_q, used_nz, row_req_nz_q, static_sc, fit_col_w,
-                bal_col_mask, shape_u, shape_s, w_fit, w_bal, strategy)
-            vals, cand0 = lax.top_k(
-                jnp.where(mask & fits0, sc0, -jnp.inf), shortlist_k + 1)
-            sl_cand = cand0[:, :shortlist_k].astype(jnp.int32)
-            sl_t = vals[:, shortlist_k]
+            if block_w:
+                sc0, sl_cand, sl_t, _, _ = solver.block_bound_prefilter(
+                    alloc_q, used_nz, row_req_nz_q, static_sc,
+                    mask & fits0, fit_col_w, bal_col_mask, shape_u,
+                    shape_s, w_fit, w_bal, strategy,
+                    jnp.int32(local_n), shortlist_k, block_w)
+            else:
+                sc0 = kernels.chunk_start_scores(
+                    alloc_q, used_nz, row_req_nz_q, static_sc, fit_col_w,
+                    bal_col_mask, shape_u, shape_s, w_fit, w_bal,
+                    strategy)
+                vals, cand0 = lax.top_k(
+                    jnp.where(mask & fits0, sc0, -jnp.inf),
+                    shortlist_k + 1)
+                sl_cand = cand0[:, :shortlist_k].astype(jnp.int32)
+                sl_t = vals[:, shortlist_k]
 
         def step(carry, inp):
             if shortlist_k:
@@ -661,7 +706,8 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
                                      rows=None, exc=None,
                                      row_req_q=None, row_req_nz_q=None,
                                      wave_w: int = 0,
-                                     pallas: bool = False):
+                                     pallas: bool = False,
+                                     block_w: int = 0):
     """Sequential-equivalent greedy over a (slice × nodes) mesh: the same
     solver body as `sharded_greedy_assign`, with the node dimension sharded
     over BOTH axes and the per-step argmax reduced hierarchically —
@@ -680,7 +726,8 @@ def sharded_greedy_assign_multislice(mesh: Mesh, req_q, req_nz_q, free_q,
     run = _solver_fn(mesh, strategy, local_n,
                      axes=(SLICE_AXIS, NODES_AXIS), shortlist_k=max(k, 0),
                      wave_w=0 if k else max(0, wave_w),
-                     pallas=bool(pallas and not k and wave_w > 1))
+                     pallas=bool(pallas and not k and wave_w > 1),
+                     block_w=_block_w_for(block_w, k, local_n))
     p = req_q.shape[0]
     if rows is None:
         rows = jnp.arange(p, dtype=jnp.int32)

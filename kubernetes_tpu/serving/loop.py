@@ -102,7 +102,8 @@ class ServingTier:
 
     def fast_path_cap(self) -> int:
         return AdaptiveTuner.fast_path_cap(
-            self.chunk_wall_est, self.fast_wall_est)
+            self.chunk_wall_est, self.fast_wall_est,
+            n_nodes=len(self.sched.cache.nodes))
 
     async def schedule_next(self, batch_size: int) -> bool:
         """One dispatch-loop iteration. Returns False when the queue
@@ -153,7 +154,8 @@ class ServingTier:
             if outstanding <= self.fast_path_cap() \
                     and self.window.rate_est \
                     <= AdaptiveTuner.fast_path_rate_limit(
-                        self.fast_wall_est):
+                        self.fast_wall_est,
+                        n_nodes=len(sched.cache.nodes)):
                 pods = await self._drain_fast(pods)
                 if not pods:
                     return True

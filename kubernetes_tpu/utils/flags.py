@@ -135,6 +135,14 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
           "interpreter everywhere (the CPU tier-1 validation mode). "
           "Structural fallbacks to the scan are counted in "
           "`solver_pallas_fallbacks_total`.", kill_switch=True),
+    _flag("KTPU_BLOCK_INDEX", True, _parse_bool,
+          "Two-level block-sparse node index for the shortlist "
+          "prefilter: per-block aggregate planes + an O(C·B) bound scan "
+          "gate which node columns the chunk-start score pass touches, "
+          "exactly (a block whose score upper bound loses to the "
+          "(K+1)-th shortlist value cannot hold a top-K column). `0` "
+          "degrades structurally to the full-width r18/r21 prefilter "
+          "call graph, bit-identical assignments.", kill_switch=True),
     _flag("KTPU_WAVE_WIDTH", None, _parse_int,
           "Wavefront width override (pods evaluated per scan step). "
           "Unset = the AdaptiveTuner policy row picks W and shrinks it "
@@ -145,7 +153,10 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
           "`optimal` forces the device-side Sinkhorn transport plan + "
           "feasible rounding for every eligible chunk, `auto` routes "
           "drain-scale and gang chunks to optimal per the tuner policy "
-          "row (serving single-pod traffic never routes here).",
+          "row (serving single-pod traffic never routes here; above "
+          "the structural large-N row non-gang chunks keep the greedy "
+          "scan — the plan's fixed dense (C,N) iteration cost is the "
+          "linear-in-N wall the block index removes).",
           kill_switch=True),
     _flag("KTPU_SINKHORN_ITERS", 24, _parse_int,
           "Sinkhorn iterations per optimal-mode chunk (the temperature "
@@ -228,6 +239,11 @@ FLAGS: dict[str, Flag] = {f.name: f for f in (
           "Shortlist width override for the pruned solve; `0` disables "
           "pruning. Unset = the tuner derives K from chunk width and "
           "fallback rate."),
+    _flag("KTPU_BLOCK_WIDTH", None, _parse_int,
+          "Block width override (node columns per block) for the "
+          "block-sparse index; `0` disables it like KTPU_BLOCK_INDEX=0. "
+          "Unset = the AdaptiveTuner's structural policy row picks the "
+          "width from the node count."),
     _flag("KTPU_ADMISSION_WINDOW", None, _parse_ms,
           "Serving admission coalesce window in MILLISECONDS (pinned "
           "for sweeps; `0` = always dispatch immediately). Unset = the "

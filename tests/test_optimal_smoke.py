@@ -67,6 +67,30 @@ class TestSolveModePolicy:
         assert t.solve_mode(4096, has_gang=False, spread=True,
                             class_mode=True) == ("greedy", True)
 
+    def test_auto_large_n_keeps_greedy_except_gangs(self):
+        """The r24 policy row: above the structural large-N signal the
+        Sinkhorn plan's fixed dense (C,N) iteration cost IS the
+        linear-in-N solve wall, so `auto` keeps non-gang drain chunks
+        on the greedy scan (no fallback bit — policy chose greedy, the
+        block-sparse prefilter makes it sublinear there). Gang chunks
+        still route optimal at any node count, and KTPU_SOLVE_MODE=
+        optimal still pins eligible chunks regardless of N."""
+        t = AdaptiveTuner()
+        t.n_nodes = AdaptiveTuner.LARGE_N
+        assert t.solve_mode(AdaptiveTuner.OPTIMAL_MIN_PODS,
+                            has_gang=False, spread=False,
+                            class_mode=True) == ("greedy", False)
+        assert t.solve_mode(2, has_gang=True, spread=False,
+                            class_mode=True) == ("optimal", False)
+        with flags.scoped_set("KTPU_SOLVE_MODE", "optimal"):
+            assert t.solve_mode(AdaptiveTuner.OPTIMAL_MIN_PODS,
+                                has_gang=False, spread=False,
+                                class_mode=True) == ("optimal", False)
+        t.n_nodes = AdaptiveTuner.LARGE_N - 1
+        assert t.solve_mode(AdaptiveTuner.OPTIMAL_MIN_PODS,
+                            has_gang=False, spread=False,
+                            class_mode=True) == ("optimal", False)
+
 
 class TestSinkhornPlan:
     def test_marginals_and_feasibility(self):

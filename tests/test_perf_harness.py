@@ -19,6 +19,9 @@ class TestPerfRunner:
         assert d["scheduled_total"] >= 20
         assert d["throughput_pods_per_sec"] > 0
         assert 0 < d["fragmentation_pct"] <= 100
+        # createNodes staging is timed into the detail JSON (the 1m
+        # preset's pre-measurement wall is recorded data, not dark).
+        assert d["staging_seconds"] > 0
 
     def test_basic_workload_tpu_backend(self):
         template = [

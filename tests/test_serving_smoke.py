@@ -105,6 +105,30 @@ class TestAdmissionPolicy:
         assert AdaptiveTuner.fast_path_rate_limit(0.6e-3) \
             == pytest.approx(833.3, rel=1e-3)
 
+    def test_fast_path_seed_scales_with_nodes(self):
+        """An UNMEASURED fast wall seeds from the 5k calibration point
+        scaled linearly with n (solve_one is a full-N scan): at 200k
+        the cold cap must read ~0.25s/40ms = 8, not the 512 clamp that
+        once let one big dispatch serial-drain 243 pods at ~125 ms
+        each. Measured walls ignore the node count entirely, and at or
+        below the calibration point the seeds are byte-identical to
+        the old policy."""
+        calib = AdaptiveTuner.FAST_PATH_SEED_CALIB_N
+        assert AdaptiveTuner.fast_path_cap(0.0, 0.0, n_nodes=calib) == 250
+        assert AdaptiveTuner.fast_path_rate_limit(0.0, n_nodes=calib) \
+            == pytest.approx(500.0)
+        # 200k: seed 40 ms → cap 0.25/0.04 ≈ 6 → clamped to the 8 floor,
+        # rate limit 0.5/0.04 = 12.5/s (serial capacity there is ~8/s).
+        assert AdaptiveTuner.fast_path_cap(0.0, 0.0, n_nodes=200_000) == 8
+        assert AdaptiveTuner.fast_path_rate_limit(0.0, n_nodes=200_000) \
+            == pytest.approx(12.5)
+        # a measured wall wins over any node count
+        assert AdaptiveTuner.fast_path_cap(0.4, 2e-3, n_nodes=200_000) \
+            == 200
+        assert AdaptiveTuner.fast_path_rate_limit(0.6e-3,
+                                                  n_nodes=200_000) \
+            == pytest.approx(833.3, rel=1e-3)
+
     def test_override_and_budget_gate(self, monkeypatch):
         monkeypatch.setenv("KTPU_ADMISSION_WINDOW", "2.5")
         win = AdmissionWindow()

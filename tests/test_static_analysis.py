@@ -323,7 +323,8 @@ class TestFlagRegistry:
         KTPU_WATCH_CACHE,
         KTPU_POLICY_INDEX, KTPU_SHARDS,
         KTPU_SHARD_THRESHOLD, KTPU_CLASS_PAD, KTPU_PIPELINE_DEPTH,
-        KTPU_SHORTLIST_K, KTPU_ADMISSION_WINDOW,
+        KTPU_SHORTLIST_K, KTPU_BLOCK_INDEX, KTPU_BLOCK_WIDTH,
+        KTPU_ADMISSION_WINDOW,
         KTPU_TRACE_THRESHOLD_MS, KTPU_DATA_DIR, KTPU_LOCK_CHECK,
         KTPU_DEBUG_FREEZE, KTPU_TEST_PLATFORM, KTPU_PROCESSES,
         KTPU_WAL, KTPU_WAL_FSYNC, KTPU_LEASE_DURATION."""
@@ -352,6 +353,8 @@ class TestFlagRegistry:
             "KTPU_CLASS_PAD": 31,
             "KTPU_PIPELINE_DEPTH": None,
             "KTPU_SHORTLIST_K": None,
+            "KTPU_BLOCK_INDEX": True,
+            "KTPU_BLOCK_WIDTH": None,
             "KTPU_ADMISSION_WINDOW": None,
             "KTPU_TRACE_THRESHOLD_MS": None,
             "KTPU_DATA_DIR": None,
@@ -369,7 +372,8 @@ class TestFlagRegistry:
                          "KTPU_SOLVE_MODE", "KTPU_TOPOLOGY",
                          "KTPU_WATCH_CACHE",
                          "KTPU_POLICY_INDEX", "KTPU_SHARDS",
-                         "KTPU_PROCESSES", "KTPU_WAL"}
+                         "KTPU_PROCESSES", "KTPU_WAL",
+                         "KTPU_BLOCK_INDEX"}
 
     def test_parse_behaviors(self, monkeypatch):
         from kubernetes_tpu.utils import flags
@@ -470,6 +474,24 @@ class TestMetricsLint:
                 "audit_log_rotations_total",
                 "audit_webhook_batches_total",
                 "audit_webhook_retries_total"} <= names
+        assert metrics_lint.run(mods) == []
+
+    def test_block_index_counters_visible_to_pass(self):
+        """Non-vacuity for the ISSUE 20 block-index metrics: the lint
+        pass actually reaches the live registrations (the scanned /
+        pruned counters the KTPU_BLOCK_INDEX flag gates, plus the
+        resident refresh histogram) — and finds them clean. A rename
+        that dropped the _total/_seconds suffixes, or a registration
+        moved out of the scanned set, fails here instead of silently
+        exempting the new names."""
+        from kubernetes_tpu.analysis.engine import load_modules
+        mods = [m for m in load_modules()
+                if m.rel == "kubernetes_tpu/metrics/registry.py"]
+        names = {name for m in mods
+                 for _k, name, _l, _ln in metrics_lint._registrations(m)}
+        assert {"scheduler_tpu_solver_blocks_scanned_total",
+                "scheduler_tpu_solver_blocks_pruned_total",
+                "scheduler_tpu_solver_block_refresh_seconds"} <= names
         assert metrics_lint.run(mods) == []
 
     def test_real_registry_would_catch_ms_gauge(self, tmp_path):
@@ -641,4 +663,26 @@ class TestTierOneGate:
         # The pallas entry wrappers in ops/solver.py are jit entries too.
         assert "greedy_assign_rescoring_wave_pallas" in solver_entries
         assert "multistart_greedy_assign_wave_pallas" in solver_entries
+        # ISSUE 20's block-sparse prefilter: the lax.cond branch bodies
+        # (exact accept vs whole-chunk full-width fallback) are named
+        # functions passed to a trace wrapper — entry points in their
+        # own right — and the walk must reach the prefilter plus every
+        # aggregate/bound/gather kernel it composes. A host sync inside
+        # any of these runs on the hottest large-N path.
+        for fn in ("block_bound_prefilter._block_exact",
+                   "block_bound_prefilter._block_fallback_full"):
+            assert fn in solver_entries, \
+                f"block cond branch {fn} not discovered as an entry"
+        for qn in ("block_bound_prefilter",
+                   "block_bound_prefilter._block_exact",
+                   "block_bound_prefilter._block_fallback_full"):
+            assert qn in solver_reach, \
+                f"purity walk no longer reaches {qn}"
+        kernels_reach = {qn for rel, qn in reach
+                         if rel == "kubernetes_tpu/ops/kernels.py"}
+        for qn in ("block_capacity_aggregates", "block_feasible_stat",
+                   "block_score_upper_bound", "gathered_start_scores",
+                   "gathered_start_scores.one", "_block_fold"):
+            assert qn in kernels_reach, \
+                f"purity walk no longer reaches block kernel {qn}"
         assert len(reach) >= 20

@@ -212,9 +212,15 @@ class TestDaemonSet:
                 assert p["spec"]["nodeName"] == pinned
             nodes_covered = {p["spec"]["nodeName"] for p in pods}
             assert len(nodes_covered) == 4
-            ds = await store.get("daemonsets", "default/agent")
-            assert ds["status"]["desiredNumberScheduled"] == 4
-            assert ds["status"]["numberReady"] == 4
+            # Status sync is its own controller pass — all pods Running
+            # does not mean the daemonset status caught up yet, so wait
+            # for it like the pods above (racy direct asserts flaked
+            # under a loaded full-suite run).
+            async def status_synced():
+                ds = await store.get("daemonsets", "default/agent")
+                return ds["status"]["desiredNumberScheduled"] == 4 \
+                    and ds["status"]["numberReady"] == 4
+            assert await wait_for(status_synced, timeout=15.0)
             await teardown()
         run(body())
 
